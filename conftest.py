@@ -1,0 +1,15 @@
+"""Root conftest: make the repo importable and force JAX onto a virtual
+8-device CPU platform for tests (multi-chip shardings are validated on a
+CPU mesh; real-TPU benchmarking happens only in bench.py)."""
+
+import os
+
+# Must run before any test module imports jax. The image's sitecustomize
+# registers the 'axon' TPU platform and pins JAX_PLATFORMS=axon; tests run
+# on CPU so they are hermetic and can fake an 8-device mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,25 @@
+"""mpi_operator_tpu — a TPU-native job operator framework.
+
+A brand-new implementation of the capability set of kubeflow/mpi-operator
+(v2beta1 generation), redesigned for TPU pod slices:
+
+- ``api``       TPUJob API types, defaulting, validation, topology math
+                (reference analog: v2/pkg/apis/kubeflow/v2beta1).
+- ``runtime``   Kubernetes-shaped object model, in-memory API server,
+                typed clients, informers, rate-limited workqueue
+                (reference analog: v2/pkg/client + k8s.io/client-go).
+- ``controller``The TPUJob reconciler and status engine
+                (reference analog: v2/pkg/controller).
+- ``launcher``  Worker-side bootstrap: env parsing and
+                jax.distributed.initialize — replaces the reference's
+                sshd + hostfile + mpirun stack.
+- ``parallel``  Device-mesh construction and GSPMD sharding rules
+                (dp/fsdp/tp/sp axes over ICI/DCN).
+- ``models``    JAX/Flax example workloads (ResNet, BERT, Llama).
+- ``ops``       TPU kernels (Pallas) and collective helpers.
+- ``utils``     Events, metrics, logging.
+- ``cmd``       Operator process entrypoint (flags, leader election,
+                healthz, metrics).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""TPUJob API: types, defaulting, validation, and TPU topology math."""

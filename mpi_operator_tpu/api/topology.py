@@ -1,0 +1,187 @@
+"""TPU slice topology math.
+
+This replaces the reference's ``slotsPerWorker`` notion
+(/root/reference/v2/pkg/apis/kubeflow/v2beta1/types.go:43-45): where an
+MPIJob declares "N slots per worker" and the operator writes it into MPI env
+(/root/reference/v2/pkg/controller/mpi_job_controller.go:1363-1377), a TPUJob
+declares a *slice* (``acceleratorType`` + optional ``topology``), and the
+operator derives from it:
+
+- how many worker pods the slice needs (one per TPU host),
+- how many chips each pod must request (``google.com/tpu`` resource),
+- the env wiring each worker needs to find its peers
+  (``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES``).
+
+Conventions (documented deviation from Cloud naming): ``acceleratorType`` is
+``<generation>-<chips>`` where ``<chips>`` always counts *chips* (Cloud's
+v2/v3/v5p names count TensorCores; we do not reproduce that inconsistency).
+Topologies are ``AxB`` (2D generations) or ``AxBxC`` (3D generations).
+
+A host owns a 2x2 block of a 2D slice or a 2x2x1 block of a 3D slice
+(4 chips/host), except small single-host slices which own all chips
+(up to 8 for the 2D generations, e.g. v5e ``2x4``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import reduce
+
+CHIPS_PER_HOST = 4
+MAX_SINGLE_HOST_CHIPS_2D = 8
+
+# generation name -> number of topology dimensions
+GENERATIONS: dict[str, int] = {
+    "v4": 3,
+    "v5e": 2,
+    "v5p": 3,
+    "v6e": 2,
+}
+
+# Standard topologies per (generation dims, chips). 2D entries follow the
+# published v5e/v6e shapes; 3D entries are near-cubes with even factors.
+_DEFAULT_2D: dict[int, str] = {
+    1: "1x1",
+    4: "2x2",
+    8: "2x4",
+    16: "4x4",
+    32: "4x8",
+    64: "8x8",
+    128: "8x16",
+    256: "16x16",
+}
+_DEFAULT_3D: dict[int, str] = {
+    8: "2x2x2",
+    16: "2x2x4",
+    32: "2x4x4",
+    64: "4x4x4",
+    128: "4x4x8",
+    256: "4x8x8",
+    512: "8x8x8",
+    1024: "8x8x16",
+    2048: "8x16x16",
+    4096: "16x16x16",
+}
+
+
+class TopologyError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class SliceShape:
+    """Resolved shape of one TPU slice."""
+
+    generation: str
+    chips: int
+    topology: str  # "AxB" or "AxBxC"
+    num_hosts: int
+    chips_per_host: int
+
+    @property
+    def accelerator_type(self) -> str:
+        return f"{self.generation}-{self.chips}"
+
+    def dims(self) -> tuple[int, ...]:
+        return parse_topology(self.topology)
+
+
+def parse_accelerator_type(accelerator_type: str) -> tuple[str, int]:
+    """``"v5e-16"`` -> ``("v5e", 16)``."""
+    parts = accelerator_type.rsplit("-", 1)
+    if len(parts) != 2 or parts[0] not in GENERATIONS:
+        raise TopologyError(
+            f"invalid acceleratorType {accelerator_type!r}: want "
+            f"<generation>-<chips> with generation in {sorted(GENERATIONS)}"
+        )
+    try:
+        chips = int(parts[1])
+    except ValueError:
+        raise TopologyError(
+            f"invalid acceleratorType {accelerator_type!r}: chip count "
+            f"{parts[1]!r} is not an integer"
+        ) from None
+    if chips <= 0:
+        raise TopologyError(
+            f"invalid acceleratorType {accelerator_type!r}: chip count must be positive"
+        )
+    return parts[0], chips
+
+
+def parse_topology(topology: str) -> tuple[int, ...]:
+    """``"4x4"`` -> ``(4, 4)``."""
+    try:
+        dims = tuple(int(p) for p in topology.split("x"))
+    except ValueError:
+        raise TopologyError(f"invalid topology {topology!r}") from None
+    if len(dims) not in (2, 3) or any(d <= 0 for d in dims):
+        raise TopologyError(
+            f"invalid topology {topology!r}: want AxB or AxBxC with positive dims"
+        )
+    return dims
+
+
+def default_topology(generation: str, chips: int) -> str:
+    ndims = GENERATIONS.get(generation)
+    if ndims is None:
+        raise TopologyError(f"unknown TPU generation {generation!r}")
+    table = _DEFAULT_2D if ndims == 2 else _DEFAULT_3D
+    topo = table.get(chips)
+    if topo is None:
+        raise TopologyError(
+            f"no standard topology for {generation}-{chips}; pass "
+            f"spec.tpu.topology explicitly (standard sizes: {sorted(table)})"
+        )
+    return topo
+
+
+def resolve(accelerator_type: str, topology: str = "") -> SliceShape:
+    """Resolve acceleratorType (+ optional explicit topology) to a SliceShape.
+
+    Raises TopologyError on inconsistency (topology product != chip count,
+    wrong dimensionality for the generation, non-integral host count).
+    """
+    generation, chips = parse_accelerator_type(accelerator_type)
+    ndims = GENERATIONS[generation]
+    if not topology:
+        topology = default_topology(generation, chips)
+    dims = parse_topology(topology)
+    if len(dims) != ndims:
+        raise TopologyError(
+            f"topology {topology!r} has {len(dims)} dims but generation "
+            f"{generation} slices are {ndims}-dimensional"
+        )
+    product = reduce(lambda a, b: a * b, dims, 1)
+    if product != chips:
+        raise TopologyError(
+            f"topology {topology!r} has {product} chips but acceleratorType "
+            f"{accelerator_type!r} declares {chips}"
+        )
+
+    if chips <= CHIPS_PER_HOST:
+        num_hosts, chips_per_host = 1, chips
+    elif ndims == 2 and chips <= MAX_SINGLE_HOST_CHIPS_2D:
+        # e.g. v5e 2x4: one 8-chip host machine.
+        num_hosts, chips_per_host = 1, chips
+    else:
+        if chips % CHIPS_PER_HOST != 0:
+            raise TopologyError(
+                f"{accelerator_type!r}: multi-host slices must have a chip "
+                f"count divisible by {CHIPS_PER_HOST}"
+            )
+        # A host owns a 2x2(x1) block, which must tile the slice: at least
+        # two topology dims must be even (chip divisibility alone does not
+        # guarantee this — e.g. 1x16 has 16 chips but no 2x2 tiling).
+        if sum(1 for d in dims if d % 2 == 0) < 2:
+            raise TopologyError(
+                f"topology {topology!r} cannot be tiled by 2x2 host blocks; "
+                f"multi-host slices need at least two even dimensions"
+            )
+        num_hosts, chips_per_host = chips // CHIPS_PER_HOST, CHIPS_PER_HOST
+    return SliceShape(
+        generation=generation,
+        chips=chips,
+        topology=topology,
+        num_hosts=num_hosts,
+        chips_per_host=chips_per_host,
+    )

@@ -1,0 +1,59 @@
+"""Well-known names shared by the API, controller, and launcher.
+
+Reference analogs: v2/pkg/apis/kubeflow/v2beta1/constants.go:5-14 plus the
+kubeflow-common label names and the controller's env wiring
+(/root/reference/v2/pkg/controller/mpi_job_controller.go:104-205).
+"""
+
+# Operator identity.
+OPERATOR_NAME = "tpu-operator"
+ENV_KUBEFLOW_NAMESPACE = "KUBEFLOW_NAMESPACE"
+
+# Default restart policies (constants.go:22-26 analog).
+DEFAULT_RESTART_POLICY = "Never"
+DEFAULT_LAUNCHER_RESTART_POLICY = "OnFailure"
+
+# Labels (kubeflow-common label-name analogs, applied by
+# mpi_job_controller.go:1502-1508).
+OPERATOR_NAME_LABEL = "training.kubeflow.org/operator-name"
+JOB_NAME_LABEL = "training.kubeflow.org/job-name"
+JOB_ROLE_LABEL = "training.kubeflow.org/job-role"
+REPLICA_INDEX_LABEL = "training.kubeflow.org/replica-index"
+
+# Role label values / object-name suffixes (mpi_job_controller.go:104-112).
+ROLE_LAUNCHER = "launcher"
+ROLE_WORKER = "worker"
+LAUNCHER_SUFFIX = "-launcher"
+WORKER_SUFFIX = "-worker"
+
+# The TPU resource name requested by worker pods — the analog of the
+# reference blanking nvidia.com/gpu for the launcher (:202-205, :1379-1383);
+# our validation *rejects* GPU resources outright (BASELINE.md north star).
+TPU_RESOURCE_NAME = "google.com/tpu"
+GPU_RESOURCE_NAME = "nvidia.com/gpu"
+
+# Env wiring for worker pods — replaces both the hostfile ConfigMap text
+# (newConfigMap, mpi_job_controller.go:1106-1128) and the OMPI/I_MPI env
+# blocks (:177-201):
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"  # pod index, GKE-compatible
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"  # comma-separated FQDNs
+ENV_TPU_ACCELERATOR_TYPE = "TPU_ACCELERATOR_TYPE"
+ENV_TPU_TOPOLOGY = "TPU_TOPOLOGY"
+ENV_TPU_CHIPS_PER_HOST = "TPU_CHIPS_PER_HOST"
+ENV_COORDINATOR_ADDRESS = "TPUJOB_COORDINATOR_ADDRESS"  # host:port of worker-0
+ENV_NUM_PROCESSES = "TPUJOB_NUM_PROCESSES"
+ENV_PROCESS_ID = "TPUJOB_PROCESS_ID"
+ENV_JOB_NAME = "TPUJOB_NAME"
+ENV_JOB_NAMESPACE = "TPUJOB_NAMESPACE"
+ENV_NUM_SLICES = "TPUJOB_NUM_SLICES"
+ENV_SLICE_ID = "TPUJOB_SLICE_ID"
+
+# Rendezvous defaults.
+DEFAULT_COORDINATOR_PORT = 8476  # jax.distributed's conventional port
+DEFAULT_CLEAN_POD_POLICY = "None"
+
+# ConfigMap keys (hostfile/discover_hosts.sh analogs,
+# mpi_job_controller.go:1106-1145).
+CONFIG_SUFFIX = "-config"
+HOSTNAMES_KEY = "hostnames"
+DISCOVER_HOSTS_KEY = "discover_hosts.sh"

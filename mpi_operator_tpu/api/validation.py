@@ -1,0 +1,247 @@
+"""Field-level validation for TPUJob.
+
+Reference analog: ValidateMPIJob,
+/root/reference/v2/pkg/apis/kubeflow/validation/validation.go:46-152.
+Same structure (an ErrorList of typed field errors with JSON paths), with
+the MPI-specific rules swapped for TPU rules:
+
+- worker hostname DNS-1123 check on ``<name>-worker-<replicas-1>``
+  (validation.go:53-65 analog — worker pods get stable DNS identity);
+- Worker spec required, replicas >= 1 and == slice hosts x numSlices
+  (inverts validation.go:117-136, where Launcher was the required one);
+- Launcher optional, replicas == 1 when present (validation.go:119-127);
+- restartPolicy in {Never, OnFailure} (validation.go:40-44);
+- runPolicy: cleanPodPolicy in {None, Running, All}, non-negative
+  ttl/activeDeadline/backoff (validation.go:88-106);
+- >= 1 container per template (validation.go:146-150);
+- TPU block: acceleratorType/topology must resolve (replaces the
+  slotsPerWorker/mpiImplementation checks, validation.go:70-84);
+- no ``nvidia.com/gpu`` resources anywhere (the reference merely blanks
+  NVIDIA env on the launcher, mpi_job_controller.go:202-205; we reject).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..runtime.objects import is_dns1123_label
+from . import topology
+from .v2beta1 import constants
+from .v2beta1.types import (
+    CLEAN_POD_POLICY_ALL,
+    CLEAN_POD_POLICY_NONE,
+    CLEAN_POD_POLICY_RUNNING,
+    REPLICA_TYPE_LAUNCHER,
+    REPLICA_TYPE_WORKER,
+    RESTART_POLICY_NEVER,
+    RESTART_POLICY_ON_FAILURE,
+    ReplicaSpec,
+    RunPolicy,
+    TPUJob,
+    TPUJobSpec,
+)
+
+VALID_CLEAN_POD_POLICIES = (
+    CLEAN_POD_POLICY_NONE,
+    CLEAN_POD_POLICY_RUNNING,
+    CLEAN_POD_POLICY_ALL,
+)
+VALID_RESTART_POLICIES = (RESTART_POLICY_NEVER, RESTART_POLICY_ON_FAILURE)
+
+
+@dataclass(frozen=True)
+class FieldError:
+    """One validation error (k8s field.Error analog)."""
+
+    type: str  # "Required" | "Invalid" | "NotSupported"
+    field: str  # JSON path, e.g. "spec.tpuReplicaSpecs[Worker].replicas"
+    value: object = None
+    detail: str = ""
+
+    def __str__(self) -> str:
+        if self.type == "Required":
+            return f"{self.field}: Required value: {self.detail}"
+        if self.type == "NotSupported":
+            return f"{self.field}: Unsupported value: {self.value!r}: {self.detail}"
+        return f"{self.field}: Invalid value: {self.value!r}: {self.detail}"
+
+
+def required(path: str, detail: str) -> FieldError:
+    return FieldError("Required", path, detail=detail)
+
+
+def invalid(path: str, value: object, detail: str) -> FieldError:
+    return FieldError("Invalid", path, value=value, detail=detail)
+
+
+def not_supported(path: str, value: object, supported) -> FieldError:
+    return FieldError(
+        "NotSupported", path, value=value, detail=f"supported values: {sorted(supported)}"
+    )
+
+
+def validate_tpujob(job: TPUJob) -> list[FieldError]:
+    errs = _validate_job_name(job)
+    errs += _validate_spec(job.spec, "spec")
+    return errs
+
+
+def _validate_job_name(job: TPUJob) -> list[FieldError]:
+    # validation.go:53-65 analog: the longest generated pod hostname must be
+    # a valid DNS-1123 label.
+    replicas = 1
+    worker = job.spec.replica_specs.get(REPLICA_TYPE_WORKER)
+    if worker is not None and worker.replicas is not None and worker.replicas > 0:
+        replicas = worker.replicas
+    hostname = f"{job.metadata.name}-worker-{replicas - 1}"
+    label_errs = is_dns1123_label(hostname)
+    if label_errs:
+        return [
+            invalid(
+                "metadata.name",
+                job.metadata.name,
+                f"will not be able to create pod with invalid DNS label "
+                f"{hostname!r}: {'; '.join(label_errs)}",
+            )
+        ]
+    return []
+
+
+def _validate_spec(spec: TPUJobSpec, path: str) -> list[FieldError]:
+    errs = _validate_replica_specs(spec, f"{path}.tpuReplicaSpecs")
+    errs += _validate_tpu(spec, path)
+    errs += _validate_run_policy(spec.run_policy, f"{path}.runPolicy")
+    if spec.jax_distribution.coordinator_port <= 0:
+        errs.append(
+            required(
+                f"{path}.jaxDistribution.coordinatorPort",
+                "must have a coordinator port for jax.distributed rendezvous",
+            )
+        )
+    elif not (0 < spec.jax_distribution.coordinator_port < 65536):
+        errs.append(
+            invalid(
+                f"{path}.jaxDistribution.coordinatorPort",
+                spec.jax_distribution.coordinator_port,
+                "must be a valid port number",
+            )
+        )
+    return errs
+
+
+def _validate_tpu(spec: TPUJobSpec, spec_path: str) -> list[FieldError]:
+    errs: list[FieldError] = []
+    tpu = spec.tpu
+    path = f"{spec_path}.tpu"
+    if not tpu.accelerator_type:
+        errs.append(required(f"{path}.acceleratorType", "must declare the TPU slice type"))
+        return errs
+    try:
+        shape = topology.resolve(tpu.accelerator_type, tpu.topology)
+    except topology.TopologyError as e:
+        errs.append(invalid(f"{path}.acceleratorType", tpu.accelerator_type, str(e)))
+        return errs
+    if tpu.num_slices < 1:
+        errs.append(invalid(f"{path}.numSlices", tpu.num_slices, "must be >= 1"))
+        return errs
+    worker = spec.replica_specs.get(REPLICA_TYPE_WORKER)
+    if worker is not None and worker.replicas is not None:
+        want = shape.num_hosts * tpu.num_slices
+        if worker.replicas != want:
+            errs.append(
+                invalid(
+                    f"{spec_path}.tpuReplicaSpecs[{REPLICA_TYPE_WORKER}].replicas",
+                    worker.replicas,
+                    f"slice {shape.accelerator_type} (topology {shape.topology}) "
+                    f"x {tpu.num_slices} slice(s) needs exactly {want} worker(s), "
+                    f"one per TPU host",
+                )
+            )
+    return errs
+
+
+def _validate_run_policy(policy: RunPolicy, path: str) -> list[FieldError]:
+    # validation.go:88-106 analog.
+    errs: list[FieldError] = []
+    if policy.clean_pod_policy is None:
+        errs.append(required(f"{path}.cleanPodPolicy", "must have clean Pod policy"))
+    elif policy.clean_pod_policy not in VALID_CLEAN_POD_POLICIES:
+        errs.append(
+            not_supported(
+                f"{path}.cleanPodPolicy", policy.clean_pod_policy, VALID_CLEAN_POD_POLICIES
+            )
+        )
+    for name, value in (
+        ("ttlSecondsAfterFinished", policy.ttl_seconds_after_finished),
+        ("activeDeadlineSeconds", policy.active_deadline_seconds),
+        ("backoffLimit", policy.backoff_limit),
+    ):
+        if value is not None and value < 0:
+            errs.append(invalid(f"{path}.{name}", value, "must be greater than or equal to 0"))
+    return errs
+
+
+def _validate_replica_specs(spec: TPUJobSpec, path: str) -> list[FieldError]:
+    # validation.go:108-136 analog with Launcher/Worker requirements swapped.
+    errs: list[FieldError] = []
+    if not spec.replica_specs:
+        errs.append(required(path, "must have replica specs"))
+        return errs
+    for rtype in spec.replica_specs:
+        if rtype not in (REPLICA_TYPE_LAUNCHER, REPLICA_TYPE_WORKER):
+            errs.append(
+                not_supported(
+                    f"{path}[{rtype}]",
+                    rtype,
+                    (REPLICA_TYPE_LAUNCHER, REPLICA_TYPE_WORKER),
+                )
+            )
+    launcher = spec.replica_specs.get(REPLICA_TYPE_LAUNCHER)
+    if launcher is not None:
+        lpath = f"{path}[{REPLICA_TYPE_LAUNCHER}]"
+        errs += _validate_replica_spec(launcher, lpath)
+        if launcher.replicas is not None and launcher.replicas != 1:
+            errs.append(invalid(f"{lpath}.replicas", launcher.replicas, "must be 1"))
+
+    worker = spec.replica_specs.get(REPLICA_TYPE_WORKER)
+    wpath = f"{path}[{REPLICA_TYPE_WORKER}]"
+    if worker is None:
+        errs.append(required(wpath, f"must have {REPLICA_TYPE_WORKER} replica spec"))
+        return errs
+    errs += _validate_replica_spec(worker, wpath)
+    if worker.replicas is not None and worker.replicas <= 0:
+        errs.append(
+            invalid(f"{wpath}.replicas", worker.replicas, "must be greater than or equal to 1")
+        )
+    return errs
+
+
+def _validate_replica_spec(spec: ReplicaSpec, path: str) -> list[FieldError]:
+    # validation.go:138-151 analog + the GPU-resource rejection.
+    errs: list[FieldError] = []
+    if spec.replicas is None:
+        errs.append(required(f"{path}.replicas", "must define number of replicas"))
+    if spec.restart_policy not in VALID_RESTART_POLICIES:
+        errs.append(
+            not_supported(f"{path}.restartPolicy", spec.restart_policy, VALID_RESTART_POLICIES)
+        )
+    pod_spec = spec.template.get("spec") or {}
+    if not (pod_spec.get("containers") or []):
+        errs.append(
+            required(
+                f"{path}.template.spec.containers", "must define at least one container"
+            )
+        )
+    for kind in ("containers", "initContainers", "ephemeralContainers"):
+        for i, container in enumerate(pod_spec.get(kind) or []):
+            for bound in ("limits", "requests"):
+                resources = (container.get("resources") or {}).get(bound) or {}
+                if constants.GPU_RESOURCE_NAME in resources:
+                    errs.append(
+                        invalid(
+                            f"{path}.template.spec.{kind}[{i}].resources.{bound}",
+                            constants.GPU_RESOURCE_NAME,
+                            "TPUJob pods must not request GPU resources",
+                        )
+                    )
+    return errs

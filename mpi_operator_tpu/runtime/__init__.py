@@ -1,0 +1,2 @@
+"""Kubernetes-shaped runtime machinery: object model, in-memory API server,
+typed clients, informers, and rate-limited workqueue."""

@@ -1,0 +1,164 @@
+"""Defaulting tests.
+
+Reference analog: /root/reference/v2/pkg/apis/kubeflow/v2beta1/default_test.go.
+"""
+
+from mpi_operator_tpu.api.v2beta1 import (
+    DEFAULT_COORDINATOR_PORT,
+    REPLICA_TYPE_LAUNCHER,
+    REPLICA_TYPE_WORKER,
+    ReplicaSpec,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+    set_defaults_tpujob,
+)
+
+
+def _job(**spec_kwargs) -> TPUJob:
+    job = TPUJob()
+    job.metadata.name = "test"
+    job.spec = TPUJobSpec(**spec_kwargs)
+    return job
+
+
+class TestSetDefaults:
+    def test_empty_job(self):
+        job = _job()
+        set_defaults_tpujob(job)
+        assert job.spec.run_policy.clean_pod_policy == "None"
+        assert job.spec.jax_distribution.coordinator_port == DEFAULT_COORDINATOR_PORT
+
+    def test_worker_replicas_derived_from_topology(self):
+        job = _job(
+            tpu=TPUSpec(accelerator_type="v5e-16"),
+            replica_specs={REPLICA_TYPE_WORKER: ReplicaSpec()},
+        )
+        set_defaults_tpujob(job)
+        worker = job.spec.replica_specs[REPLICA_TYPE_WORKER]
+        assert worker.replicas == 4  # v5e-16 = 4 hosts
+        assert worker.restart_policy == "Never"
+        assert job.spec.tpu.topology == "4x4"
+
+    def test_worker_replicas_not_overridden(self):
+        job = _job(
+            tpu=TPUSpec(accelerator_type="v5e-16"),
+            replica_specs={REPLICA_TYPE_WORKER: ReplicaSpec(replicas=7)},
+        )
+        set_defaults_tpujob(job)
+        assert job.spec.replica_specs[REPLICA_TYPE_WORKER].replicas == 7
+
+    def test_launcher_defaults(self):
+        job = _job(
+            replica_specs={
+                REPLICA_TYPE_LAUNCHER: ReplicaSpec(),
+                REPLICA_TYPE_WORKER: ReplicaSpec(replicas=2),
+            }
+        )
+        set_defaults_tpujob(job)
+        launcher = job.spec.replica_specs[REPLICA_TYPE_LAUNCHER]
+        assert launcher.replicas == 1
+        assert launcher.restart_policy == "OnFailure"
+
+    def test_launcher_restart_policy_not_overridden(self):
+        job = _job(
+            replica_specs={REPLICA_TYPE_LAUNCHER: ReplicaSpec(restart_policy="Never")}
+        )
+        set_defaults_tpujob(job)
+        assert (
+            job.spec.replica_specs[REPLICA_TYPE_LAUNCHER].restart_policy == "Never"
+        )
+
+    def test_no_worker_spec_is_untouched(self):
+        job = _job()
+        set_defaults_tpujob(job)
+        assert REPLICA_TYPE_WORKER not in job.spec.replica_specs
+
+    def test_worker_without_accelerator_defaults_to_zero(self):
+        # Mirrors the reference's worker replicas=0 default (default.go:48);
+        # validation then rejects it.
+        job = _job(replica_specs={REPLICA_TYPE_WORKER: ReplicaSpec()})
+        set_defaults_tpujob(job)
+        assert job.spec.replica_specs[REPLICA_TYPE_WORKER].replicas == 0
+
+    def test_bad_accelerator_type_left_for_validation(self):
+        job = _job(
+            tpu=TPUSpec(accelerator_type="bogus-3"),
+            replica_specs={REPLICA_TYPE_WORKER: ReplicaSpec()},
+        )
+        set_defaults_tpujob(job)  # must not raise
+        assert job.spec.tpu.topology == ""
+
+    def test_defaulting_is_idempotent(self):
+        job = _job(
+            tpu=TPUSpec(accelerator_type="v5p-64"),
+            replica_specs={
+                REPLICA_TYPE_LAUNCHER: ReplicaSpec(),
+                REPLICA_TYPE_WORKER: ReplicaSpec(),
+            },
+        )
+        set_defaults_tpujob(job)
+        once = job.to_dict()
+        set_defaults_tpujob(job)
+        assert job.to_dict() == once
+
+
+class TestSerde:
+    def test_round_trip(self):
+        job = _job(
+            tpu=TPUSpec(accelerator_type="v5e-32", topology="4x8", num_slices=2),
+            replica_specs={
+                REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=16,
+                    restart_policy="Never",
+                    template={
+                        "spec": {
+                            "containers": [
+                                {"name": "main", "image": "img", "command": ["train"]}
+                            ]
+                        }
+                    },
+                )
+            },
+        )
+        set_defaults_tpujob(job)
+        job.status.start_time = 123.0
+        d = job.to_dict()
+        back = TPUJob.from_dict(d)
+        assert back.to_dict() == d
+        assert back.spec.tpu.num_slices == 2
+        assert back.spec.replica_specs[REPLICA_TYPE_WORKER].replicas == 16
+
+
+class TestMultislice:
+    def test_worker_replicas_derived_across_slices(self):
+        job = _job(
+            tpu=TPUSpec(accelerator_type="v5e-16", num_slices=2),
+            replica_specs={REPLICA_TYPE_WORKER: ReplicaSpec()},
+        )
+        set_defaults_tpujob(job)
+        assert job.spec.replica_specs[REPLICA_TYPE_WORKER].replicas == 8
+
+    def test_invalid_num_slices_preserved_for_validation(self):
+        from mpi_operator_tpu.api.validation import validate_tpujob
+
+        job = TPUJob.from_dict(
+            {
+                "metadata": {"name": "t"},
+                "spec": {
+                    "tpu": {"acceleratorType": "v5e-16", "numSlices": 0},
+                    "tpuReplicaSpecs": {
+                        "Worker": {
+                            "template": {
+                                "spec": {"containers": [{"name": "m", "image": "i"}]}
+                            }
+                        }
+                    },
+                },
+            }
+        )
+        assert job.spec.tpu.num_slices == 0
+        set_defaults_tpujob(job)
+        assert job.spec.tpu.num_slices == 0
+        errs = validate_tpujob(job)
+        assert any(e.field == "spec.tpu.numSlices" for e in errs)

@@ -1,0 +1,207 @@
+"""Validation tests.
+
+Reference analog: /root/reference/v2/pkg/apis/kubeflow/validation/validation_test.go.
+"""
+
+import pytest
+
+from mpi_operator_tpu.api.v2beta1 import (
+    REPLICA_TYPE_LAUNCHER,
+    REPLICA_TYPE_WORKER,
+    JAXDistributionSpec,
+    ReplicaSpec,
+    RunPolicy,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+    set_defaults_tpujob,
+)
+from mpi_operator_tpu.api.validation import validate_tpujob
+
+TEMPLATE = {"spec": {"containers": [{"name": "main", "image": "img"}]}}
+
+
+def valid_job(workers: int = 4) -> TPUJob:
+    job = TPUJob()
+    job.metadata.name = "test"
+    job.metadata.namespace = "default"
+    job.spec = TPUJobSpec(
+        tpu=TPUSpec(accelerator_type="v5e-16"),
+        replica_specs={
+            REPLICA_TYPE_WORKER: ReplicaSpec(replicas=workers, template=dict(TEMPLATE))
+        },
+    )
+    set_defaults_tpujob(job)
+    return job
+
+
+def fields(errs):
+    return {e.field for e in errs}
+
+
+class TestValidJobs:
+    def test_minimal_valid(self):
+        assert validate_tpujob(valid_job()) == []
+
+    def test_with_launcher(self):
+        job = valid_job()
+        job.spec.replica_specs[REPLICA_TYPE_LAUNCHER] = ReplicaSpec(
+            replicas=1, restart_policy="OnFailure", template=dict(TEMPLATE)
+        )
+        assert validate_tpujob(job) == []
+
+    def test_multislice(self):
+        job = valid_job(workers=8)
+        job.spec.tpu.num_slices = 2
+        assert validate_tpujob(job) == []
+
+
+class TestInvalidJobs:
+    def test_missing_replica_specs(self):
+        job = valid_job()
+        job.spec.replica_specs = {}
+        errs = validate_tpujob(job)
+        assert "spec.tpuReplicaSpecs" in fields(errs)
+
+    def test_missing_worker(self):
+        job = valid_job()
+        job.spec.replica_specs[REPLICA_TYPE_LAUNCHER] = ReplicaSpec(
+            replicas=1, restart_policy="OnFailure", template=dict(TEMPLATE)
+        )
+        del job.spec.replica_specs[REPLICA_TYPE_WORKER]
+        errs = validate_tpujob(job)
+        assert "spec.tpuReplicaSpecs[Worker]" in fields(errs)
+
+    def test_unknown_replica_type(self):
+        job = valid_job()
+        job.spec.replica_specs["Chief"] = ReplicaSpec(replicas=1, template=dict(TEMPLATE))
+        errs = validate_tpujob(job)
+        assert "spec.tpuReplicaSpecs[Chief]" in fields(errs)
+
+    def test_worker_replicas_zero(self):
+        job = valid_job()
+        job.spec.replica_specs[REPLICA_TYPE_WORKER].replicas = 0
+        errs = validate_tpujob(job)
+        # zero workers both violates >=1 and mismatches the slice host count
+        assert "spec.tpuReplicaSpecs[Worker].replicas" in fields(errs)
+
+    def test_worker_replicas_mismatch_topology(self):
+        job = valid_job()
+        job.spec.replica_specs[REPLICA_TYPE_WORKER].replicas = 3
+        errs = validate_tpujob(job)
+        matched = [e for e in errs if e.field == "spec.tpuReplicaSpecs[Worker].replicas"]
+        assert matched and "one per TPU host" in matched[0].detail
+
+    def test_launcher_replicas_must_be_one(self):
+        job = valid_job()
+        job.spec.replica_specs[REPLICA_TYPE_LAUNCHER] = ReplicaSpec(
+            replicas=2, restart_policy="OnFailure", template=dict(TEMPLATE)
+        )
+        errs = validate_tpujob(job)
+        assert "spec.tpuReplicaSpecs[Launcher].replicas" in fields(errs)
+
+    def test_bad_restart_policy(self):
+        job = valid_job()
+        job.spec.replica_specs[REPLICA_TYPE_WORKER].restart_policy = "Always"
+        errs = validate_tpujob(job)
+        assert "spec.tpuReplicaSpecs[Worker].restartPolicy" in fields(errs)
+
+    def test_no_containers(self):
+        job = valid_job()
+        job.spec.replica_specs[REPLICA_TYPE_WORKER].template = {"spec": {"containers": []}}
+        errs = validate_tpujob(job)
+        assert "spec.tpuReplicaSpecs[Worker].template.spec.containers" in fields(errs)
+
+    def test_gpu_resources_rejected(self):
+        job = valid_job()
+        job.spec.replica_specs[REPLICA_TYPE_WORKER].template = {
+            "spec": {
+                "containers": [
+                    {
+                        "name": "main",
+                        "image": "img",
+                        "resources": {"limits": {"nvidia.com/gpu": 1}},
+                    }
+                ]
+            }
+        }
+        errs = validate_tpujob(job)
+        assert any("nvidia.com/gpu" in str(e) for e in errs)
+
+    def test_bad_clean_pod_policy(self):
+        job = valid_job()
+        job.spec.run_policy.clean_pod_policy = "Sometimes"
+        errs = validate_tpujob(job)
+        assert "spec.runPolicy.cleanPodPolicy" in fields(errs)
+
+    def test_missing_clean_pod_policy(self):
+        job = valid_job()
+        job.spec.run_policy.clean_pod_policy = None
+        errs = validate_tpujob(job)
+        assert "spec.runPolicy.cleanPodPolicy" in fields(errs)
+
+    @pytest.mark.parametrize(
+        "field_name",
+        ["ttlSecondsAfterFinished", "activeDeadlineSeconds", "backoffLimit"],
+    )
+    def test_negative_run_policy_fields(self, field_name):
+        job = valid_job()
+        attr = {
+            "ttlSecondsAfterFinished": "ttl_seconds_after_finished",
+            "activeDeadlineSeconds": "active_deadline_seconds",
+            "backoffLimit": "backoff_limit",
+        }[field_name]
+        setattr(job.spec.run_policy, attr, -1)
+        errs = validate_tpujob(job)
+        assert f"spec.runPolicy.{field_name}" in fields(errs)
+
+    def test_missing_accelerator_type(self):
+        job = valid_job()
+        job.spec.tpu.accelerator_type = ""
+        errs = validate_tpujob(job)
+        assert "spec.tpu.acceleratorType" in fields(errs)
+
+    def test_inconsistent_topology(self):
+        job = valid_job()
+        job.spec.tpu.topology = "2x2"
+        errs = validate_tpujob(job)
+        assert "spec.tpu.acceleratorType" in fields(errs)
+
+    def test_bad_coordinator_port(self):
+        job = valid_job()
+        job.spec.jax_distribution = JAXDistributionSpec(coordinator_port=99999)
+        errs = validate_tpujob(job)
+        assert "spec.jaxDistribution.coordinatorPort" in fields(errs)
+
+    def test_job_name_too_long_for_pod_hostname(self):
+        # validation_test.go name-length analog: the generated worker
+        # hostname must stay a DNS-1123 label.
+        job = valid_job()
+        job.metadata.name = "a" * 60
+        errs = validate_tpujob(job)
+        assert "metadata.name" in fields(errs)
+
+    def test_job_name_invalid_characters(self):
+        job = valid_job()
+        job.metadata.name = "Not_A_Label"
+        errs = validate_tpujob(job)
+        assert "metadata.name" in fields(errs)
+
+
+class TestGPUInAuxContainers:
+    def test_gpu_in_init_containers_rejected(self):
+        job = valid_job()
+        job.spec.replica_specs[REPLICA_TYPE_WORKER].template = {
+            "spec": {
+                "containers": [{"name": "main", "image": "img"}],
+                "initContainers": [
+                    {
+                        "name": "init",
+                        "image": "img",
+                        "resources": {"requests": {"nvidia.com/gpu": 1}},
+                    }
+                ],
+            }
+        }
+        errs = validate_tpujob(job)
+        assert any("initContainers" in e.field for e in errs)

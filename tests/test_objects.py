@@ -1,0 +1,51 @@
+"""Object-model tests (ObjectMeta/KubeObject serde, owner references)."""
+
+from mpi_operator_tpu.runtime.objects import (
+    KubeObject,
+    ObjectMeta,
+    get_controller_of,
+    is_dns1123_label,
+    new_controller_ref,
+)
+
+
+class TestKubeObject:
+    def test_reading_payload_does_not_mutate(self):
+        a = KubeObject("v1", "Pod", ObjectMeta(name="a"))
+        b = KubeObject("v1", "Pod", ObjectMeta(name="a"))
+        assert a == b
+        _ = a.spec  # read-only access must not change serialized form
+        _ = a.status
+        assert a == b
+        assert "spec" not in a.to_dict()
+
+    def test_mutation_through_accessor_sticks(self):
+        pod = KubeObject("v1", "Pod", ObjectMeta(name="p"))
+        pod.status["phase"] = "Running"
+        assert pod.to_dict()["status"] == {"phase": "Running"}
+
+    def test_round_trip(self):
+        pod = KubeObject(
+            "v1",
+            "Pod",
+            ObjectMeta(name="p", namespace="ns", labels={"a": "b"}),
+            spec={"containers": [{"name": "c"}]},
+        )
+        d = pod.to_dict()
+        assert KubeObject.from_dict(d).to_dict() == d
+
+    def test_controller_ref(self):
+        owner = KubeObject("v1", "Job", ObjectMeta(name="j", uid="u1"))
+        ref = new_controller_ref(owner, "v1", "Job")
+        child = KubeObject("v1", "Pod", ObjectMeta(name="p", owner_references=[ref]))
+        got = get_controller_of(child)
+        assert got is not None and got.uid == "u1" and got.controller
+
+
+class TestDNSLabel:
+    def test_valid(self):
+        assert is_dns1123_label("abc-123") == []
+
+    def test_invalid(self):
+        assert is_dns1123_label("-abc")
+        assert is_dns1123_label("A" * 64)

@@ -1,0 +1,84 @@
+import pytest
+
+from mpi_operator_tpu.api import topology
+from mpi_operator_tpu.api.topology import TopologyError, resolve
+
+
+class TestResolve:
+    @pytest.mark.parametrize(
+        "atype,topo,hosts,chips_per_host",
+        [
+            ("v5e-1", "1x1", 1, 1),
+            ("v5e-4", "2x2", 1, 4),
+            ("v5e-8", "2x4", 1, 8),  # single 8-chip host machine
+            ("v5e-16", "4x4", 4, 4),
+            ("v5e-32", "4x8", 8, 4),
+            ("v5e-256", "16x16", 64, 4),
+            ("v6e-16", "4x4", 4, 4),
+            ("v4-32", "2x4x4", 8, 4),
+            ("v5p-64", "4x4x4", 16, 4),
+            ("v5p-8", "2x2x2", 2, 4),
+        ],
+    )
+    def test_standard_shapes(self, atype, topo, hosts, chips_per_host):
+        shape = resolve(atype)
+        assert shape.topology == topo
+        assert shape.num_hosts == hosts
+        assert shape.chips_per_host == chips_per_host
+        assert shape.accelerator_type == atype
+
+    def test_explicit_topology_overrides_default(self):
+        shape = resolve("v5e-16", "2x8")
+        assert shape.topology == "2x8"
+        assert shape.num_hosts == 4
+
+    def test_topology_chip_mismatch(self):
+        with pytest.raises(TopologyError, match="16 chips"):
+            resolve("v5e-32", "4x4")
+
+    def test_wrong_dimensionality(self):
+        with pytest.raises(TopologyError, match="3-dimensional"):
+            resolve("v5p-64", "8x8")
+        with pytest.raises(TopologyError, match="2-dimensional"):
+            resolve("v5e-16", "2x2x4")
+
+    def test_unknown_generation(self):
+        with pytest.raises(TopologyError, match="generation"):
+            resolve("v99-8")
+
+    def test_bad_chip_count(self):
+        with pytest.raises(TopologyError):
+            resolve("v5e-0")
+        with pytest.raises(TopologyError):
+            resolve("v5e-banana")
+
+    def test_nonstandard_size_needs_explicit_topology(self):
+        with pytest.raises(TopologyError, match="pass"):
+            resolve("v5e-12")
+
+    def test_dims(self):
+        assert resolve("v4-32").dims() == (2, 4, 4)
+
+
+class TestParsers:
+    def test_parse_accelerator_type(self):
+        assert topology.parse_accelerator_type("v5p-128") == ("v5p", 128)
+
+    def test_parse_topology(self):
+        assert topology.parse_topology("8x16") == (8, 16)
+        with pytest.raises(TopologyError):
+            topology.parse_topology("8")
+        with pytest.raises(TopologyError):
+            topology.parse_topology("2x-2")
+
+
+class TestHostTiling:
+    def test_untileable_multihost_topology_rejected(self):
+        # 1x16 has 16 chips but no 2x2 host-block tiling.
+        with pytest.raises(TopologyError, match="2x2 host blocks"):
+            resolve("v5e-16", "1x16")
+
+    def test_odd_third_dim_3d_ok(self):
+        # 2x2x1 blocks can tile 2x2x3 (two even dims suffice).
+        shape = resolve("v4-12", "2x2x3")
+        assert shape.num_hosts == 3
