@@ -1,0 +1,1 @@
+"""The TPUJob reconciler: status engine, object builders, controller."""

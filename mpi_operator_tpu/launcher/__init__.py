@@ -1,0 +1,12 @@
+"""Worker-side bootstrap: the TPU-native replacement for the reference's
+sshd + hostfile + mpirun stack (reference analog:
+/root/reference/v2/pkg/controller/mpi_job_controller.go:1272-1274 worker
+sshd default, :1330-1422 launcher mpirun wiring).
+
+Every worker pod runs the same SPMD program; this package turns the env
+the controller injected (``TPUJOB_*`` / ``TPU_WORKER_*``) into a
+``jax.distributed.initialize`` call, after which XLA collectives ride
+ICI/DCN — no SSH, no remote shells, no rank spawning.
+"""
+
+from .bootstrap import RendezvousConfig, initialize  # noqa: F401

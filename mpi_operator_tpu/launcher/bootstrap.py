@@ -1,0 +1,120 @@
+"""Rendezvous bootstrap for TPUJob workers.
+
+The controller injects the rendezvous env (builders._worker_env); this
+module consumes it.  The equivalent moment in the reference is `mpirun`
+reading the hostfile and ssh-ing into workers
+(/root/reference/v2/pkg/controller/mpi_job_controller.go:177-191) — here
+every worker calls ``initialize()`` itself and the JAX distributed runtime
+forms the world.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Mapping, Optional
+
+from ..api.v2beta1 import constants
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class RendezvousConfig:
+    coordinator_address: str = ""
+    num_processes: int = 1
+    process_id: int = 0
+    worker_id: int = 0
+    worker_hostnames: tuple[str, ...] = ()
+    accelerator_type: str = ""
+    topology: str = ""
+    chips_per_host: int = 0
+    num_slices: int = 1
+    slice_id: int = 0
+    job_name: str = ""
+    job_namespace: str = ""
+
+    @classmethod
+    def from_env(cls, environ: Optional[Mapping[str, str]] = None) -> "RendezvousConfig":
+        env = os.environ if environ is None else environ
+
+        def _int(name: str, default: int) -> int:
+            try:
+                return int(env.get(name, default))
+            except (TypeError, ValueError):
+                return default
+
+        hostnames = tuple(
+            h for h in env.get(constants.ENV_TPU_WORKER_HOSTNAMES, "").split(",") if h
+        )
+        return cls(
+            coordinator_address=env.get(constants.ENV_COORDINATOR_ADDRESS, ""),
+            num_processes=_int(constants.ENV_NUM_PROCESSES, 1),
+            process_id=_int(constants.ENV_PROCESS_ID, 0),
+            worker_id=_int(constants.ENV_TPU_WORKER_ID, 0),
+            worker_hostnames=hostnames,
+            accelerator_type=env.get(constants.ENV_TPU_ACCELERATOR_TYPE, ""),
+            topology=env.get(constants.ENV_TPU_TOPOLOGY, ""),
+            chips_per_host=_int(constants.ENV_TPU_CHIPS_PER_HOST, 0),
+            num_slices=_int(constants.ENV_NUM_SLICES, 1),
+            slice_id=_int(constants.ENV_SLICE_ID, 0),
+            job_name=env.get(constants.ENV_JOB_NAME, ""),
+            job_namespace=env.get(constants.ENV_JOB_NAMESPACE, ""),
+        )
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+_initialized = False
+
+
+def initialize(
+    config: Optional[RendezvousConfig] = None,
+    *,
+    initialization_timeout_seconds: int = 300,
+) -> RendezvousConfig:
+    """Join the job's jax.distributed world (idempotent).
+
+    Single-process jobs (num_processes == 1) skip distributed init
+    entirely, so the same worker image runs unchanged on one host.
+    """
+    global _initialized
+    cfg = config or RendezvousConfig.from_env()
+    if not cfg.is_distributed:
+        log.info("single-process TPUJob; skipping jax.distributed.initialize")
+        return cfg
+    if _initialized:
+        return cfg
+
+    import jax
+
+    log.info(
+        "jax.distributed.initialize coordinator=%s process=%d/%d",
+        cfg.coordinator_address,
+        cfg.process_id,
+        cfg.num_processes,
+    )
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+        initialization_timeout=initialization_timeout_seconds,
+    )
+    _initialized = True
+    return cfg
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        import jax
+
+        jax.distributed.shutdown()
+        _initialized = False

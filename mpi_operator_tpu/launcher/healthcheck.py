@@ -1,0 +1,57 @@
+"""Collective smoke test — the default worker command.
+
+Reference analog: the default worker command `/usr/sbin/sshd -De`
+(/root/reference/v2/pkg/controller/mpi_job_controller.go:1272-1274) and the
+pi MPI_Reduce e2e payload (/root/reference/examples/v2beta1/pi/pi.cc:19-50)
+rolled into one TPU-native program: join the jax.distributed world, run a
+real cross-host allgather, verify every rank contributed, exit 0.
+
+Run as ``python -m mpi_operator_tpu.launcher.healthcheck``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from .bootstrap import RendezvousConfig, initialize
+
+
+def run_healthcheck(config: RendezvousConfig | None = None) -> dict:
+    cfg = initialize(config)
+    import jax
+    import numpy as np
+
+    device_count = jax.device_count()
+    local_device_count = jax.local_device_count()
+
+    if cfg.is_distributed:
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(np.array([cfg.process_id]))
+        seen = sorted(int(x) for x in np.asarray(gathered).ravel())
+        ok = seen == list(range(cfg.num_processes))
+    else:
+        # Single process: a local all-device reduction still proves the
+        # chips answer.
+        import jax.numpy as jnp
+
+        ok = bool(jnp.ones((local_device_count,)).sum() == local_device_count)
+
+    return {
+        "ok": ok,
+        "process_id": cfg.process_id,
+        "num_processes": cfg.num_processes,
+        "device_count": device_count,
+        "local_device_count": local_device_count,
+    }
+
+
+def main() -> int:
+    result = run_healthcheck()
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,212 @@
+"""Shared informers and listers.
+
+Reference analog: the generated informer/lister machinery in
+/root/reference/v2/pkg/client/informers + k8s.io/client-go informers, as
+wired in mpi_job_controller.go:249-347 (event handlers) and :355-377
+(WaitForCacheSync before workers start).
+
+Each informer keeps a local cache (the lister's view) fed by an apiserver
+watch stream.  Event delivery is *pumped*: ``pump()`` applies buffered
+watch events to the cache and fires handlers.  Tests pump synchronously
+for determinism; the operator process runs a pump loop in a thread.  This
+mirrors the real informer property that the cache can lag the apiserver,
+which is exactly what the reference's deep-copy-before-mutate discipline
+(mpi_job_controller.go:475-478) is guarding against.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .apiserver import ADDED, DELETED, MODIFIED, InMemoryAPIServer, match_labels
+
+
+def split_key(key: str) -> tuple[str, str]:
+    """"namespace/name" -> (namespace, name) (cache.SplitMetaNamespaceKey)."""
+    if "/" in key:
+        ns, name = key.split("/", 1)
+        return ns, name
+    return "", key
+
+
+def meta_namespace_key(obj: dict) -> str:
+    meta = obj.get("metadata") or {}
+    ns = meta.get("namespace", "")
+    name = meta.get("name", "")
+    return f"{ns}/{name}" if ns else name
+
+
+@dataclass
+class EventHandler:
+    on_add: Optional[Callable[[dict], None]] = None
+    on_update: Optional[Callable[[dict, dict], None]] = None
+    on_delete: Optional[Callable[[dict], None]] = None
+
+
+class Lister:
+    """Read-only view over an informer cache (namespace/name keyed dicts)."""
+
+    def __init__(self, informer: "Informer"):
+        self._informer = informer
+
+    def get(self, namespace: str, name: str) -> Optional[dict]:
+        return self._informer.cache_get(namespace, name)
+
+    def list(
+        self,
+        namespace: Optional[str] = None,
+        label_selector: Optional[dict[str, str]] = None,
+    ) -> list[dict]:
+        return self._informer.cache_list(namespace, label_selector)
+
+
+class Informer:
+    def __init__(self, api: InMemoryAPIServer, resource: str):
+        self._api = api
+        self.resource = resource
+        self._lock = threading.RLock()
+        self._cache: dict[str, dict] = {}
+        self._handlers: list[EventHandler] = []
+        self._watch = None
+        self._synced = False
+        self.lister = Lister(self)
+
+    # -- cache reads -----------------------------------------------------
+
+    def cache_get(self, namespace: str, name: str) -> Optional[dict]:
+        with self._lock:
+            obj = self._cache.get(f"{namespace}/{name}" if namespace else name)
+            return None if obj is None else _deep_copy(obj)
+
+    def cache_list(
+        self,
+        namespace: Optional[str] = None,
+        label_selector: Optional[dict[str, str]] = None,
+    ) -> list[dict]:
+        with self._lock:
+            out = []
+            for obj in self._cache.values():
+                meta = obj.get("metadata") or {}
+                if namespace is not None and meta.get("namespace", "") != namespace:
+                    continue
+                if not match_labels(label_selector, meta.get("labels") or {}):
+                    continue
+                out.append(_deep_copy(obj))
+            out.sort(
+                key=lambda o: (
+                    o["metadata"].get("namespace", ""),
+                    o["metadata"]["name"],
+                )
+            )
+            return out
+
+    # -- lifecycle -------------------------------------------------------
+
+    def add_event_handler(self, handler: EventHandler) -> None:
+        self._handlers.append(handler)
+
+    def start(self) -> None:
+        """Open the watch, then load the initial listing into the cache.
+
+        Opening the watch first guarantees no lost updates: anything that
+        changes between list and first pump arrives as a watch event.
+        """
+        with self._lock:
+            if self._watch is not None:
+                return
+            self._watch = self._api.watch(self.resource)
+            for obj in self._api.list(self.resource):
+                key = meta_namespace_key(obj)
+                self._cache[key] = obj
+            self._synced = True
+        # Initial adds fire outside the lock.
+        for obj in self.cache_list():
+            for h in self._handlers:
+                if h.on_add:
+                    h.on_add(obj)
+
+    @property
+    def has_synced(self) -> bool:
+        return self._synced
+
+    def pump(self) -> int:
+        """Apply buffered watch events to the cache; fire handlers.
+
+        Returns the number of events processed.  Events already reflected in
+        the initial list (same resourceVersion) collapse into no-op updates,
+        which handlers still see — the workqueue dedups, as in client-go.
+        """
+        if self._watch is None:
+            raise RuntimeError(f"informer for {self.resource} not started")
+        events = self._watch.drain()
+        for event in events:
+            key = meta_namespace_key(event.object)
+            with self._lock:
+                old = self._cache.get(key)
+                if event.type == DELETED:
+                    self._cache.pop(key, None)
+                else:
+                    self._cache[key] = event.object
+            if event.type == ADDED and old is None:
+                for h in self._handlers:
+                    if h.on_add:
+                        h.on_add(_deep_copy(event.object))
+            elif event.type == DELETED:
+                for h in self._handlers:
+                    if h.on_delete:
+                        h.on_delete(_deep_copy(old if old is not None else event.object))
+            else:  # MODIFIED, or ADDED already seen via initial list
+                base = old if old is not None else event.object
+                for h in self._handlers:
+                    if h.on_update:
+                        h.on_update(_deep_copy(base), _deep_copy(event.object))
+        return len(events)
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._watch is not None:
+                self._watch.stop()
+                self._watch = None
+
+
+class InformerFactory:
+    """Shared informer factory (one informer per resource).
+
+    Reference analog: kubeinformers.NewSharedInformerFactory +
+    informers.NewSharedInformerFactory in app/server.go:139-147.
+    """
+
+    def __init__(self, api: InMemoryAPIServer):
+        self._api = api
+        self._informers: dict[str, Informer] = {}
+
+    def informer(self, resource: str) -> Informer:
+        if resource not in self._informers:
+            self._informers[resource] = Informer(self._api, resource)
+        return self._informers[resource]
+
+    def start_all(self) -> None:
+        for informer in self._informers.values():
+            informer.start()
+
+    def pump_all(self) -> int:
+        """One pump round across all informers; returns events processed."""
+        return sum(informer.pump() for informer in self._informers.values())
+
+    def pump_until_quiet(self, max_rounds: int = 100) -> None:
+        """Pump until no informer has buffered events (test convenience)."""
+        for _ in range(max_rounds):
+            if self.pump_all() == 0:
+                return
+        raise RuntimeError("informers did not quiesce")
+
+    def stop_all(self) -> None:
+        for informer in self._informers.values():
+            informer.stop()
+
+
+def _deep_copy(obj: dict) -> dict:
+    return copy.deepcopy(obj)

@@ -1,0 +1,166 @@
+"""Rate-limited workqueue.
+
+Reference analog: k8s.io/client-go/util/workqueue as used by the controller
+(/root/reference/v2/pkg/controller/mpi_job_controller.go:237, :294,
+:389-446): deduplicating delay-capable queue + per-item exponential backoff
+rate limiter, so a failing TPUJob retries with backoff while a hot TPUJob
+only ever occupies one queue slot.
+
+Semantics kept from client-go:
+- an item added while queued is deduplicated;
+- an item added while *being processed* is remembered (dirty set) and
+  re-queued when ``done()`` is called;
+- ``add_rate_limited`` delays re-adds exponentially per item until
+  ``forget()`` resets the failure count;
+- ``shutdown()`` unblocks all getters.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Any, Hashable, Optional
+
+
+class ItemExponentialFailureRateLimiter:
+    """Per-item exponential backoff (client-go default: 5ms base, 1000s cap)."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._failures: dict[Hashable, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: Hashable) -> float:
+        with self._lock:
+            failures = self._failures.get(item, 0)
+            self._failures[item] = failures + 1
+            delay = self.base_delay * (2**failures)
+            return min(delay, self.max_delay)
+
+    def forget(self, item: Hashable) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: Hashable) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class RateLimitingQueue:
+    def __init__(
+        self,
+        rate_limiter: Optional[ItemExponentialFailureRateLimiter] = None,
+        name: str = "",
+        clock=time.monotonic,
+    ):
+        self.name = name
+        self._rate_limiter = rate_limiter or ItemExponentialFailureRateLimiter()
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._queue: list[Any] = []  # FIFO of ready items
+        self._queued: set[Hashable] = set()  # dedup: in _queue or delayed
+        self._processing: set[Hashable] = set()
+        self._dirty: set[Hashable] = set()  # re-add requested while processing
+        self._delayed: list[tuple[float, int, Any]] = []  # heap (ready_at, seq, item)
+        self._seq = 0
+        self._shutdown = False
+
+    # -- core queue ------------------------------------------------------
+
+    def add(self, item: Hashable) -> None:
+        with self._cond:
+            if self._shutdown:
+                return
+            if item in self._processing:
+                self._dirty.add(item)
+                return
+            if item in self._queued:
+                return
+            self._queued.add(item)
+            self._queue.append(item)
+            self._cond.notify()
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._cond:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (self._clock() + delay, self._seq, item))
+            self._cond.notify()
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        self.add_after(item, self._rate_limiter.when(item))
+
+    def forget(self, item: Hashable) -> None:
+        self._rate_limiter.forget(item)
+
+    def num_requeues(self, item: Hashable) -> int:
+        return self._rate_limiter.num_requeues(item)
+
+    def _promote_ready(self) -> Optional[float]:
+        """Move due delayed items into the FIFO; return next wake-up delay."""
+        now = self._clock()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, item = heapq.heappop(self._delayed)
+            if item in self._processing:
+                self._dirty.add(item)
+            elif item not in self._queued:
+                self._queued.add(item)
+                self._queue.append(item)
+        if self._delayed:
+            return self._delayed[0][0] - now
+        return None
+
+    def get(self, timeout: Optional[float] = None) -> tuple[Any, bool]:
+        """Return (item, shutdown). Blocks until an item is ready."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while True:
+                next_delay = self._promote_ready()
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._queued.discard(item)
+                    self._processing.add(item)
+                    return item, False
+                if self._shutdown:
+                    return None, True
+                wait = next_delay
+                if deadline is not None:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        return None, False
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cond.wait(wait)
+
+    def done(self, item: Hashable) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._dirty.discard(item)
+                if item not in self._queued:
+                    self._queued.add(item)
+                    self._queue.append(item)
+                    self._cond.notify()
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    @property
+    def is_shutdown(self) -> bool:
+        with self._cond:
+            return self._shutdown
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def pending_delayed(self) -> int:
+        with self._cond:
+            return len(self._delayed)

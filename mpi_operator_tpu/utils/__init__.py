@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: event recording, metrics, logging."""

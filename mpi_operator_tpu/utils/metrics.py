@@ -1,0 +1,112 @@
+"""Prometheus-style metrics registry (no external dependency).
+
+Reference analog: the promauto counters/gauges in
+/root/reference/v2/pkg/controller/mpi_job_controller.go:120-136 and the
+/metrics endpoint in v2/cmd/mpi-operator/main.go:29-40.  Same metric names
+with the ``tpu_operator_`` prefix, exposed in Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, registry: Optional["Registry"]):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        self._values: dict[tuple[str, ...], float] = {}
+        self._label_names: tuple[str, ...] = ()
+        if registry is not None:
+            registry.register(self)
+
+    def _set_labels(self, label_names: tuple[str, ...]) -> None:
+        self._label_names = label_names
+
+    def _samples(self) -> list[tuple[tuple[str, ...], float]]:
+        with self._lock:
+            if not self._values and not self._label_names:
+                return [((), 0.0)]
+            return sorted(self._values.items())
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        for labels, value in self._samples():
+            if labels:
+                label_str = ",".join(
+                    f'{n}="{v}"' for n, v in zip(self._label_names, labels)
+                )
+                lines.append(f"{self.name}{{{label_str}}} {value}")
+            else:
+                lines.append(f"{self.name} {value}")
+        return "\n".join(lines)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, *labels: str) -> None:
+        with self._lock:
+            self._values[labels] = self._values.get(labels, 0.0) + amount
+
+    def value(self, *labels: str) -> float:
+        with self._lock:
+            return self._values.get(labels, 0.0)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def labels(self, *label_values: str) -> "_GaugeView":
+        return _GaugeView(self, label_values)
+
+    def set(self, value: float, *labels: str) -> None:
+        with self._lock:
+            self._values[labels] = value
+
+    def value(self, *labels: str) -> float:
+        with self._lock:
+            return self._values.get(labels, 0.0)
+
+
+class _GaugeView:
+    def __init__(self, gauge: Gauge, label_values: tuple[str, ...]):
+        self._gauge = gauge
+        self._labels = label_values
+
+    def set(self, value: float) -> None:
+        self._gauge.set(value, *self._labels)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list[_Metric] = []
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric) -> None:
+        with self._lock:
+            self._metrics.append(metric)
+
+    def expose(self) -> str:
+        with self._lock:
+            return "\n".join(m.expose() for m in self._metrics) + "\n"
+
+
+DEFAULT_REGISTRY = Registry()
+
+
+def new_counter(name: str, help_: str, registry: Optional[Registry] = None) -> Counter:
+    return Counter(name, help_, registry or DEFAULT_REGISTRY)
+
+
+def new_gauge(
+    name: str,
+    help_: str,
+    label_names: tuple[str, ...] = (),
+    registry: Optional[Registry] = None,
+) -> Gauge:
+    gauge = Gauge(name, help_, registry or DEFAULT_REGISTRY)
+    gauge._set_labels(label_names)
+    return gauge

@@ -1,0 +1,546 @@
+"""TPUJobController unit tests.
+
+Reference analog: /root/reference/v2/pkg/controller/mpi_job_controller_test.go
+(fixture with fake clientsets + seeded listers + action assertions).  The
+in-memory API server plays the fake clientset; informers are started and
+pumped synchronously; ``sync_handler`` is driven directly like the
+reference's ``f.run(...)``.
+"""
+
+import pytest
+
+from mpi_operator_tpu.api.v2beta1 import (
+    REPLICA_TYPE_LAUNCHER,
+    REPLICA_TYPE_WORKER,
+    ReplicaSpec,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+)
+from mpi_operator_tpu.controller import builders
+from mpi_operator_tpu.controller import status as st
+from mpi_operator_tpu.controller.tpu_job_controller import TPUJobController
+from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer
+
+TEMPLATE = {"spec": {"containers": [{"name": "main", "image": "tpu-image"}]}}
+NOW = 1000.0
+
+
+class Fixture:
+    """mpi_job_controller_test.go:58-88 fixture analog."""
+
+    def __init__(self, gang: str = ""):
+        self.time = [NOW]
+        self.api = InMemoryAPIServer(clock=lambda: self.time[0])
+        self.controller = TPUJobController(
+            self.api, gang_scheduler_name=gang, clock=lambda: self.time[0]
+        )
+
+    def start(self):
+        self.controller.start()
+
+    def new_job(self, name="test-job", workers=4, launcher=False, **tpu_kwargs) -> TPUJob:
+        job = TPUJob()
+        job.metadata.name = name
+        job.metadata.namespace = "default"
+        job.spec = TPUJobSpec(
+            tpu=TPUSpec(accelerator_type=tpu_kwargs.pop("accelerator_type", "v5e-16")),
+            replica_specs={
+                REPLICA_TYPE_WORKER: ReplicaSpec(replicas=workers, template=dict(TEMPLATE))
+            },
+        )
+        for k, v in tpu_kwargs.items():
+            setattr(job.spec.run_policy, k, v)
+        if launcher:
+            job.spec.replica_specs[REPLICA_TYPE_LAUNCHER] = ReplicaSpec(
+                template={"spec": {"containers": [{"name": "l", "image": "tpu-image"}]}}
+            )
+        return job
+
+    def create_job(self, job: TPUJob) -> TPUJob:
+        created = self.controller.tpujobs.tpujobs("default").create(job)
+        return created
+
+    def sync(self, job: TPUJob):
+        self.controller.factory.pump_until_quiet()
+        self.controller.sync_handler(f"{job.namespace}/{job.name}")
+        self.controller.factory.pump_until_quiet()
+
+    def get_job(self, name="test-job") -> TPUJob:
+        return self.controller.tpujobs.tpujobs("default").get(name)
+
+    def set_pod_phase(self, name: str, phase: str, reason: str = ""):
+        pod = self.api.get("pods", "default", name)
+        pod["status"] = {"phase": phase}
+        if reason:
+            pod["status"]["reason"] = reason
+        self.api.update_status("pods", pod)
+
+    def set_all_workers_phase(self, job: TPUJob, phase: str):
+        for i in range(builders.worker_replicas(job)):
+            self.set_pod_phase(builders.worker_name(job, i), phase)
+
+    def mark_launcher(self, job: TPUJob, cond_type: str, reason: str = "", message: str = ""):
+        name = builders.launcher_name(job)
+        launcher = self.api.get("jobs", "default", name)
+        launcher["status"] = {
+            "conditions": [
+                {"type": cond_type, "status": "True", "reason": reason, "message": message}
+            ]
+        }
+        if cond_type == "Complete":
+            launcher["status"]["completionTime"] = self.time[0]
+        self.api.update_status("jobs", launcher)
+
+    def events(self):
+        return [(e.type, e.reason) for e in self.controller.recorder.events]
+
+
+def make_synced_job(f: Fixture, **kwargs):
+    job = f.new_job(**kwargs)
+    f.start()
+    created = f.create_job(job)
+    f.sync(created)
+    return f.get_job(created.name)
+
+
+class TestAllResourcesCreated:
+    """mpi_job_controller_test.go TestAllResourcesCreated :459 analog."""
+
+    def test_launcherless(self):
+        f = Fixture()
+        job = make_synced_job(f)
+        # Headless service fronting workers.
+        svc = f.api.get("services", "default", "test-job-worker")
+        assert svc["spec"]["clusterIP"] == "None"
+        assert svc["spec"]["selector"]["training.kubeflow.org/job-role"] == "worker"
+        # ConfigMap with hostnames + discover_hosts.
+        cm = f.api.get("configmaps", "default", "test-job-config")
+        hosts = cm["data"]["hostnames"].strip().split("\n")
+        assert hosts[0] == "test-job-worker-0.test-job-worker.default.svc"
+        assert len(hosts) == 4
+        assert cm["data"]["discover_hosts.sh"].startswith("#!/bin/sh")
+        # 4 worker pods (one per v5e-16 host), no launcher, no SSH secret.
+        pods = f.api.list("pods")
+        assert len(pods) == 4
+        assert f.api.list("secrets") == []
+        assert f.api.list("jobs") == []
+        # Status: Created condition, initialized worker statuses.
+        assert st.has_condition(job.status, "Created")
+        assert job.status.start_time == NOW
+        assert job.status.replica_statuses[REPLICA_TYPE_WORKER].active == 0
+        assert f.controller.jobs_created.value() == 1
+
+    def test_with_launcher(self):
+        f = Fixture()
+        make_synced_job(f, launcher=True)
+        launcher = f.api.get("jobs", "default", "test-job-launcher")
+        tmpl = launcher["spec"]["template"]
+        assert tmpl["metadata"]["labels"]["training.kubeflow.org/job-role"] == "launcher"
+        assert tmpl["metadata"]["labels"]["job-name"] == "test-job-launcher"
+
+    def test_sync_idempotent(self):
+        f = Fixture()
+        job = make_synced_job(f)
+        f.api.clear_actions()
+        f.sync(job)
+        # Second sync with no cluster change: no writes at all.
+        writes = [a for a in f.api.actions if a[0] != "get"]
+        assert writes == []
+
+
+class TestWorkerPodGolden:
+    """TestNewLauncherAndWorker :952 golden-object analog."""
+
+    def test_worker_pod_shape(self):
+        f = Fixture()
+        f.start()
+        job = f.create_job(f.new_job())
+        f.sync(job)
+        pod = f.api.get("pods", "default", "test-job-worker-1")
+        spec = pod["spec"]
+        assert spec["hostname"] == "test-job-worker-1"
+        assert spec["subdomain"] == "test-job-worker"
+        assert spec["restartPolicy"] == "Never"
+        env = {e["name"]: e["value"] for e in spec["containers"][0]["env"]}
+        assert env["TPU_WORKER_ID"] == "1"
+        assert env["TPU_WORKER_HOSTNAMES"].split(",")[1] == (
+            "test-job-worker-1.test-job-worker.default.svc"
+        )
+        assert env["TPUJOB_COORDINATOR_ADDRESS"] == (
+            "test-job-worker-0.test-job-worker.default.svc:8476"
+        )
+        assert env["TPUJOB_NUM_PROCESSES"] == "4"
+        assert env["TPU_ACCELERATOR_TYPE"] == "v5e-16"
+        assert env["TPU_TOPOLOGY"] == "4x4"
+        # TPU resource injection: 4 chips per host on v5e-16.
+        assert spec["containers"][0]["resources"]["limits"]["google.com/tpu"] == 4
+        # Default command is the collective health check.
+        assert spec["containers"][0]["command"][-1] == "mpi_operator_tpu.launcher.healthcheck"
+        # Owner reference points at the TPUJob.
+        ref = pod["metadata"]["ownerReferences"][0]
+        assert ref["kind"] == "TPUJob" and ref["controller"]
+        assert pod["metadata"]["labels"]["training.kubeflow.org/replica-index"] == "1"
+
+    def test_user_command_and_resources_respected(self):
+        f = Fixture()
+        f.start()
+        job = f.new_job()
+        job.spec.replica_specs[REPLICA_TYPE_WORKER].template = {
+            "spec": {
+                "containers": [
+                    {
+                        "name": "main",
+                        "image": "img",
+                        "command": ["python", "train.py"],
+                        "resources": {"limits": {"google.com/tpu": 8}},
+                    }
+                ]
+            }
+        }
+        job.spec.tpu.accelerator_type = "v5e-8"
+        job.spec.replica_specs[REPLICA_TYPE_WORKER].replicas = 1
+        job = f.create_job(job)
+        f.sync(job)
+        pod = f.api.get("pods", "default", "test-job-worker-0")
+        assert pod["spec"]["containers"][0]["command"] == ["python", "train.py"]
+        assert pod["spec"]["containers"][0]["resources"]["limits"]["google.com/tpu"] == 8
+
+
+class TestLauncherLifecycle:
+    def test_launcher_succeeded(self):
+        """TestLauncherSucceeded :519 analog."""
+        f = Fixture()
+        job = make_synced_job(f, launcher=True)
+        f.mark_launcher(job, "Complete")
+        f.sync(job)
+        job = f.get_job()
+        assert st.is_succeeded(job.status)
+        assert job.status.completion_time is not None
+        assert job.status.replica_statuses[REPLICA_TYPE_LAUNCHER].succeeded == 1
+        assert f.controller.jobs_successful.value() == 1
+        assert ("Normal", "TPUJobSucceeded") in f.events()
+
+    def test_launcher_failed_with_backoff_enrichment(self):
+        """TestLauncherFailed + updateMPIJobFailedStatus :973-1004 analog."""
+        f = Fixture()
+        job = make_synced_job(f, launcher=True)
+        # A failed launcher pod to enrich from.
+        f.api.create(
+            "pods",
+            {
+                "metadata": {
+                    "name": "test-job-launcher-x1",
+                    "namespace": "default",
+                    "labels": {"job-name": "test-job-launcher"},
+                },
+                "status": {
+                    "phase": "Failed",
+                    "reason": "OOMKilled",
+                    "message": "container exceeded memory limit",
+                },
+            },
+        )
+        f.mark_launcher(job, "Failed", reason="BackoffLimitExceeded", message="Job has failed")
+        f.sync(job)
+        job = f.get_job()
+        assert st.is_failed(job.status)
+        cond = st.get_condition(job.status, "Failed")
+        assert cond.reason == "BackoffLimitExceeded/OOMKilled"
+        assert "container exceeded memory limit" in cond.message
+        assert f.controller.jobs_failed.value() == 1
+
+    def test_running_condition_requires_launcher_and_workers(self):
+        f = Fixture()
+        job = make_synced_job(f, launcher=True)
+        f.set_all_workers_phase(job, "Running")
+        f.api.create(
+            "pods",
+            {
+                "metadata": {
+                    "name": "test-job-launcher-abc",
+                    "namespace": "default",
+                    "labels": {"job-name": "test-job-launcher"},
+                },
+                "status": {"phase": "Running"},
+            },
+        )
+        f.sync(job)
+        job = f.get_job()
+        assert st.has_condition(job.status, "Running")
+        assert job.status.replica_statuses[REPLICA_TYPE_WORKER].active == 4
+        assert job.status.replica_statuses[REPLICA_TYPE_LAUNCHER].active == 1
+
+
+class TestLauncherlessLifecycle:
+    def test_workers_running_sets_running(self):
+        """TestWorkerReady :897 analog for the SPMD path."""
+        f = Fixture()
+        job = make_synced_job(f)
+        f.set_all_workers_phase(job, "Running")
+        f.sync(job)
+        job = f.get_job()
+        assert st.has_condition(job.status, "Running")
+        assert job.status.replica_statuses[REPLICA_TYPE_WORKER].active == 4
+
+    def test_all_workers_succeeded_job_succeeds(self):
+        f = Fixture()
+        job = make_synced_job(f)
+        f.set_all_workers_phase(job, "Succeeded")
+        f.sync(job)
+        job = f.get_job()
+        assert st.is_succeeded(job.status)
+        assert job.status.replica_statuses[REPLICA_TYPE_WORKER].succeeded == 4
+        assert job.status.completion_time is not None
+        # Running condition flipped to False by the terminal transition.
+        running = st.get_condition(job.status, "Running")
+        assert running is None or running.status == "False"
+
+    def test_worker_failed_job_fails(self):
+        f = Fixture()
+        job = make_synced_job(f)
+        f.set_all_workers_phase(job, "Running")
+        f.sync(job)
+        f.set_pod_phase("test-job-worker-2", "Failed")
+        f.sync(job)
+        job = f.get_job()
+        assert st.is_failed(job.status)
+        cond = st.get_condition(job.status, "Failed")
+        assert "test-job-worker-2" in cond.message
+        assert f.controller.jobs_failed.value() == 1
+
+    def test_evicted_worker_sets_evicted_condition(self):
+        f = Fixture()
+        job = make_synced_job(f)
+        f.set_pod_phase("test-job-worker-1", "Failed", reason="Evicted")
+        f.sync(job)
+        job = f.get_job()
+        cond = st.get_condition(job.status, "Failed")
+        assert cond.reason == "TPUJobEvicted"
+        assert ("Warning", "TPUJobEvicted") in f.events()
+
+    def test_active_deadline_exceeded(self):
+        f = Fixture()
+        job = make_synced_job(f, active_deadline_seconds=60)
+        f.set_all_workers_phase(job, "Running")
+        f.time[0] = NOW + 120
+        f.sync(job)
+        job = f.get_job()
+        cond = st.get_condition(job.status, "Failed")
+        assert cond is not None and cond.reason == "DeadlineExceeded"
+        # workers torn down
+        f.controller.factory.pump_until_quiet()
+        assert f.api.list("pods") == []
+
+
+class TestCleanPodPolicy:
+    """TestShutdownWorker :710 analog."""
+
+    @pytest.mark.parametrize("policy,kept", [("All", 0), ("Running", 2), ("None", 4)])
+    def test_cleanup_after_success(self, policy, kept):
+        f = Fixture()
+        job = make_synced_job(f, clean_pod_policy=policy)
+        # Two workers finished, two still running when the job completes.
+        for i in range(2):
+            f.set_pod_phase(builders.worker_name(job, i), "Succeeded")
+        for i in range(2, 4):
+            f.set_pod_phase(builders.worker_name(job, i), "Running")
+        # Force terminal state.
+        jd = f.api.get("tpujobs", "default", "test-job")
+        jd["status"]["conditions"] = [
+            {"type": "Succeeded", "status": "True", "reason": "TPUJobSucceeded"}
+        ]
+        jd["status"]["completionTime"] = NOW
+        f.api.update_status("tpujobs", jd)
+        f.sync(job)
+        f.controller.factory.pump_until_quiet()
+        assert len(f.api.list("pods")) == kept
+        if policy != "None":
+            job = f.get_job()
+            assert job.status.replica_statuses[REPLICA_TYPE_WORKER].active == 0
+
+
+class TestScaleDown:
+    def test_excess_workers_deleted(self):
+        """getOrCreateWorker scale-down :814-830 analog: v5e-32 -> v5e-16."""
+        f = Fixture()
+        job = make_synced_job(f, workers=8, accelerator_type="v5e-32")
+        assert len(f.api.list("pods")) == 8
+        jd = f.api.get("tpujobs", "default", "test-job")
+        jd["spec"]["tpu"] = {"acceleratorType": "v5e-16"}
+        jd["spec"]["tpuReplicaSpecs"]["Worker"]["replicas"] = 4
+        f.api.update("tpujobs", jd)
+        f.sync(job)
+        f.controller.factory.pump_until_quiet()
+        names = {p["metadata"]["name"] for p in f.api.list("pods")}
+        assert names == {f"test-job-worker-{i}" for i in range(4)}
+
+
+class TestAdoptionConflicts:
+    """TestLauncherNotControlledByUs :501 family analog."""
+
+    def test_foreign_service_flagged(self):
+        f = Fixture()
+        f.start()
+        f.api.create(
+            "services",
+            {"metadata": {"name": "test-job-worker", "namespace": "default"}},
+        )
+        job = f.create_job(f.new_job())
+        f.controller.factory.pump_until_quiet()
+        with pytest.raises(RuntimeError, match="not controlled"):
+            f.controller.sync_handler("default/test-job")
+        assert ("Warning", "ErrResourceExists") in f.events()
+
+    def test_foreign_launcher_flagged(self):
+        f = Fixture()
+        f.start()
+        f.api.create(
+            "jobs", {"metadata": {"name": "test-job-launcher", "namespace": "default"}}
+        )
+        job = f.create_job(f.new_job(launcher=True))
+        f.controller.factory.pump_until_quiet()
+        with pytest.raises(RuntimeError, match="not controlled"):
+            f.controller.sync_handler("default/test-job")
+
+
+class TestValidationRejected:
+    def test_invalid_job_emits_event_not_requeued(self):
+        f = Fixture()
+        f.start()
+        job = f.new_job(workers=3)  # 3 != 4 hosts of v5e-16
+        created = f.create_job(job)
+        f.sync(created)
+        assert ("Warning", "ValidationError") in f.events()
+        assert f.api.list("pods") == []
+
+
+class TestSuspendResume:
+    def test_suspend_tears_down_and_resume_rebuilds(self):
+        f = Fixture()
+        job = make_synced_job(f)
+        assert len(f.api.list("pods")) == 4
+        jd = f.api.get("tpujobs", "default", "test-job")
+        jd["spec"]["runPolicy"] = {"suspend": True, "cleanPodPolicy": "None"}
+        f.api.update("tpujobs", jd)
+        f.sync(job)
+        f.controller.factory.pump_until_quiet()
+        assert f.api.list("pods") == []
+        refreshed = f.get_job()
+        assert st.is_suspended(refreshed.status)
+        # Resume.
+        jd = f.api.get("tpujobs", "default", "test-job")
+        jd["spec"]["runPolicy"] = {"suspend": False, "cleanPodPolicy": "None"}
+        f.api.update("tpujobs", jd)
+        f.sync(job)
+        f.controller.factory.pump_until_quiet()
+        assert len(f.api.list("pods")) == 4
+        refreshed = f.get_job()
+        assert not st.is_suspended(refreshed.status)
+        assert ("Normal", "TPUJobResumed") in f.events()
+
+
+class TestGangScheduling:
+    def test_podgroup_created_with_full_gang(self):
+        f = Fixture(gang="volcano")
+        job = make_synced_job(f, launcher=True)
+        pg = f.api.get("podgroups", "default", "test-job")
+        assert pg["spec"]["minMember"] == 5  # 4 workers + 1 launcher
+        pod = f.api.get("pods", "default", "test-job-worker-0")
+        assert pod["spec"]["schedulerName"] == "volcano"
+        assert pod["metadata"]["annotations"]["scheduling.k8s.io/group-name"] == "test-job"
+
+    def test_podgroup_deleted_on_cleanup(self):
+        f = Fixture(gang="volcano")
+        job = make_synced_job(f, clean_pod_policy="All")
+        jd = f.api.get("tpujobs", "default", "test-job")
+        jd["status"]["conditions"] = [
+            {"type": "Succeeded", "status": "True", "reason": "TPUJobSucceeded"}
+        ]
+        jd["status"]["completionTime"] = NOW
+        f.api.update_status("tpujobs", jd)
+        f.sync(job)
+        assert f.api.list("podgroups") == []
+
+
+class TestElasticDiscoverHosts:
+    def test_discover_hosts_tracks_running_workers(self):
+        """updateDiscoverHostsInConfigMap :1131-1145 analog."""
+        f = Fixture()
+        job = make_synced_job(f)
+        f.set_pod_phase("test-job-worker-0", "Running")
+        f.set_pod_phase("test-job-worker-2", "Running")
+        f.sync(job)
+        cm = f.api.get("configmaps", "default", "test-job-config")
+        script = cm["data"]["discover_hosts.sh"]
+        assert "test-job-worker-0.test-job-worker.default.svc" in script
+        assert "test-job-worker-2.test-job-worker.default.svc" in script
+        assert "test-job-worker-1" not in script
+
+
+class TestOwnerRefEnqueue:
+    def test_dependent_pod_event_enqueues_owner(self):
+        f = Fixture()
+        job = make_synced_job(f)
+        # A pod status change should re-enqueue the owning TPUJob.
+        f.set_pod_phase("test-job-worker-0", "Running")
+        f.controller.factory.pump_until_quiet()
+        key, _ = f.controller.queue.get(timeout=1)
+        assert key == "default/test-job"
+        f.controller.queue.done(key)
+
+    def test_launcher_pod_event_walks_job_indirection(self):
+        f = Fixture()
+        job = make_synced_job(f, launcher=True)
+        launcher = f.api.get("jobs", "default", "test-job-launcher")
+        f.controller.factory.pump_until_quiet()
+        # Drain anything queued so far.
+        while True:
+            key, _ = f.controller.queue.get(timeout=0.05)
+            if key is None:
+                break
+            f.controller.queue.done(key)
+        f.api.create(
+            "pods",
+            {
+                "metadata": {
+                    "name": "test-job-launcher-pod",
+                    "namespace": "default",
+                    "ownerReferences": [
+                        {
+                            "apiVersion": "batch/v1",
+                            "kind": "Job",
+                            "name": "test-job-launcher",
+                            "uid": launcher["metadata"]["uid"],
+                            "controller": True,
+                        }
+                    ],
+                },
+            },
+        )
+        f.controller.factory.pump_until_quiet()
+        key, _ = f.controller.queue.get(timeout=1)
+        assert key == "default/test-job"
+        f.controller.queue.done(key)
+
+
+class TestMultisliceEnv:
+    def test_tpu_env_is_slice_local_process_env_global(self):
+        f = Fixture()
+        f.start()
+        job = f.new_job(workers=8)
+        job.spec.tpu.num_slices = 2
+        job = f.create_job(job)
+        f.sync(job)
+        pod = f.api.get("pods", "default", "test-job-worker-5")
+        env = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+        # slice-local identity: worker 5 is host 1 of slice 1
+        assert env["TPUJOB_SLICE_ID"] == "1"
+        assert env["TPU_WORKER_ID"] == "1"
+        hostnames = env["TPU_WORKER_HOSTNAMES"].split(",")
+        assert len(hostnames) == 4
+        assert hostnames[0].startswith("test-job-worker-4.")
+        # global process identity spans both slices
+        assert env["TPUJOB_PROCESS_ID"] == "5"
+        assert env["TPUJOB_NUM_PROCESSES"] == "8"
+        assert env["TPUJOB_NUM_SLICES"] == "2"

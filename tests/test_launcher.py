@@ -1,0 +1,48 @@
+"""Launcher bootstrap tests (env parsing + single-process paths)."""
+
+from mpi_operator_tpu.launcher.bootstrap import RendezvousConfig, initialize
+from mpi_operator_tpu.launcher.healthcheck import run_healthcheck
+
+ENV = {
+    "TPUJOB_COORDINATOR_ADDRESS": "j-worker-0.j-worker.ns.svc:8476",
+    "TPUJOB_NUM_PROCESSES": "4",
+    "TPUJOB_PROCESS_ID": "2",
+    "TPU_WORKER_ID": "2",
+    "TPU_WORKER_HOSTNAMES": "a.svc,b.svc,c.svc,d.svc",
+    "TPU_ACCELERATOR_TYPE": "v5e-16",
+    "TPU_TOPOLOGY": "4x4",
+    "TPU_CHIPS_PER_HOST": "4",
+    "TPUJOB_NAME": "j",
+    "TPUJOB_NAMESPACE": "ns",
+}
+
+
+class TestRendezvousConfig:
+    def test_from_env(self):
+        cfg = RendezvousConfig.from_env(ENV)
+        assert cfg.coordinator_address == "j-worker-0.j-worker.ns.svc:8476"
+        assert cfg.num_processes == 4
+        assert cfg.process_id == 2
+        assert cfg.worker_hostnames == ("a.svc", "b.svc", "c.svc", "d.svc")
+        assert cfg.is_distributed and not cfg.is_coordinator
+        assert cfg.accelerator_type == "v5e-16"
+
+    def test_empty_env_is_single_process(self):
+        cfg = RendezvousConfig.from_env({})
+        assert not cfg.is_distributed
+        assert cfg.is_coordinator
+
+    def test_garbage_ints_fall_back(self):
+        cfg = RendezvousConfig.from_env({"TPUJOB_NUM_PROCESSES": "banana"})
+        assert cfg.num_processes == 1
+
+
+class TestSingleProcess:
+    def test_initialize_skips_distributed(self):
+        cfg = initialize(RendezvousConfig())  # must not touch jax.distributed
+        assert not cfg.is_distributed
+
+    def test_healthcheck_local(self):
+        result = run_healthcheck(RendezvousConfig())
+        assert result["ok"]
+        assert result["local_device_count"] >= 1

@@ -1,0 +1,262 @@
+"""Runtime machinery tests: apiserver semantics, informers, workqueue.
+
+Reference analog: the behaviors client-go/fake clientsets guarantee and the
+reference controller relies on (optimistic concurrency, watch streams,
+GC cascades, workqueue dedup + backoff).
+"""
+
+import threading
+
+import pytest
+
+from mpi_operator_tpu.runtime.apiserver import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExistsError,
+    ConflictError,
+    InMemoryAPIServer,
+    NotFoundError,
+)
+from mpi_operator_tpu.runtime.client import KubeClient, TPUJobClient
+from mpi_operator_tpu.runtime.informer import EventHandler, InformerFactory
+from mpi_operator_tpu.runtime.objects import KubeObject, ObjectMeta
+from mpi_operator_tpu.runtime.workqueue import (
+    ItemExponentialFailureRateLimiter,
+    RateLimitingQueue,
+)
+
+
+def pod(name, ns="default", labels=None, phase=None) -> dict:
+    d = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"containers": [{"name": "c"}]},
+    }
+    if labels:
+        d["metadata"]["labels"] = labels
+    if phase:
+        d["status"] = {"phase": phase}
+    return d
+
+
+class TestAPIServerCRUD:
+    def test_create_assigns_identity(self):
+        api = InMemoryAPIServer(clock=lambda: 42.0)
+        created = api.create("pods", pod("a"))
+        assert created["metadata"]["uid"]
+        assert created["metadata"]["resourceVersion"] == "1"
+        assert created["metadata"]["creationTimestamp"] == 42.0
+
+    def test_create_duplicate(self):
+        api = InMemoryAPIServer()
+        api.create("pods", pod("a"))
+        with pytest.raises(AlreadyExistsError):
+            api.create("pods", pod("a"))
+
+    def test_get_not_found(self):
+        api = InMemoryAPIServer()
+        with pytest.raises(NotFoundError):
+            api.get("pods", "default", "nope")
+
+    def test_update_conflict_on_stale_rv(self):
+        api = InMemoryAPIServer()
+        created = api.create("pods", pod("a"))
+        api.update("pods", created)  # bumps rv
+        with pytest.raises(ConflictError):
+            api.update("pods", created)  # stale rv
+
+    def test_update_preserves_status(self):
+        api = InMemoryAPIServer()
+        created = api.create("pods", pod("a", phase="Running"))
+        created["status"] = {"phase": "Running"}
+        stored = api.update_status("pods", created)
+        spec_update = {k: v for k, v in stored.items() if k != "status"}
+        spec_update["spec"] = {"containers": [{"name": "c2"}]}
+        after = api.update("pods", spec_update)
+        assert after["status"]["phase"] == "Running"
+        assert after["spec"]["containers"][0]["name"] == "c2"
+
+    def test_update_status_only_touches_status(self):
+        api = InMemoryAPIServer()
+        created = api.create("pods", pod("a"))
+        created["spec"] = {"containers": [{"name": "sneaky"}]}
+        created["status"] = {"phase": "Failed"}
+        after = api.update_status("pods", created)
+        assert after["spec"]["containers"][0]["name"] == "c"
+        assert after["status"]["phase"] == "Failed"
+
+    def test_list_label_selector_and_namespace(self):
+        api = InMemoryAPIServer()
+        api.create("pods", pod("a", labels={"app": "x"}))
+        api.create("pods", pod("b", labels={"app": "y"}))
+        api.create("pods", pod("c", ns="other", labels={"app": "x"}))
+        got = api.list("pods", "default", {"app": "x"})
+        assert [o["metadata"]["name"] for o in got] == ["a"]
+        assert len(api.list("pods")) == 3
+
+    def test_delete_cascades_owner_references(self):
+        api = InMemoryAPIServer()
+        owner = api.create("tpujobs", {"metadata": {"name": "job", "namespace": "default"}})
+        child = pod("job-worker-0")
+        child["metadata"]["ownerReferences"] = [
+            {"uid": owner["metadata"]["uid"], "controller": True}
+        ]
+        api.create("pods", child)
+        grandchild = pod("job-worker-0-log")
+        # chain: tpujob -> pod -> pod (contrived, proves recursion)
+        grandchild["metadata"]["ownerReferences"] = [
+            {"uid": api.get("pods", "default", "job-worker-0")["metadata"]["uid"]}
+        ]
+        api.create("pods", grandchild)
+        api.delete("tpujobs", "default", "job")
+        assert api.list("pods") == []
+
+
+class TestWatch:
+    def test_watch_sees_lifecycle(self):
+        api = InMemoryAPIServer()
+        w = api.watch("pods")
+        created = api.create("pods", pod("a"))
+        api.update("pods", created)
+        api.delete("pods", "default", "a")
+        types = [e.type for e in w.drain()]
+        assert types == [ADDED, MODIFIED, DELETED]
+
+    def test_watch_blocking_next(self):
+        api = InMemoryAPIServer()
+        w = api.watch("pods")
+        t = threading.Thread(target=lambda: api.create("pods", pod("a")))
+        t.start()
+        event = w.next(timeout=5)
+        t.join()
+        assert event is not None and event.type == ADDED
+
+    def test_stopped_watch_gets_nothing(self):
+        api = InMemoryAPIServer()
+        w = api.watch("pods")
+        w.stop()
+        api.create("pods", pod("a"))
+        assert w.drain() == []
+
+
+class TestInformer:
+    def test_initial_list_then_events(self):
+        api = InMemoryAPIServer()
+        api.create("pods", pod("pre"))
+        factory = InformerFactory(api)
+        informer = factory.informer("pods")
+        adds, updates, deletes = [], [], []
+        informer.add_event_handler(
+            EventHandler(
+                on_add=lambda o: adds.append(o["metadata"]["name"]),
+                on_update=lambda o, n: updates.append(n["metadata"]["name"]),
+                on_delete=lambda o: deletes.append(o["metadata"]["name"]),
+            )
+        )
+        factory.start_all()
+        assert informer.has_synced
+        assert adds == ["pre"]
+
+        created = api.create("pods", pod("post"))
+        api.update("pods", created)
+        api.delete("pods", "default", "post")
+        factory.pump_until_quiet()
+        assert adds == ["pre", "post"]
+        assert updates == ["post"]
+        assert deletes == ["post"]
+
+    def test_lister_views_cache(self):
+        api = InMemoryAPIServer()
+        factory = InformerFactory(api)
+        informer = factory.informer("pods")
+        factory.start_all()
+        api.create("pods", pod("a", labels={"app": "x"}))
+        assert informer.lister.get("default", "a") is None  # cache lags
+        factory.pump_until_quiet()
+        assert informer.lister.get("default", "a")["metadata"]["name"] == "a"
+        assert len(informer.lister.list("default", {"app": "x"})) == 1
+
+
+class TestTypedClients:
+    def test_kube_client_round_trip(self):
+        api = InMemoryAPIServer()
+        kube = KubeClient(api)
+        svc = KubeObject(
+            "v1", "Service", ObjectMeta(name="svc"), spec={"clusterIP": "None"}
+        )
+        created = kube.services("default").create(svc)
+        assert created.metadata.uid
+        got = kube.services("default").get("svc")
+        assert got.spec == {"clusterIP": "None"}
+        assert got.metadata.namespace == "default"
+
+    def test_tpujob_client_status_subresource(self):
+        from mpi_operator_tpu.api.v2beta1 import TPUJob
+
+        api = InMemoryAPIServer()
+        client = TPUJobClient(api)
+        job = TPUJob()
+        job.metadata.name = "j"
+        created = client.tpujobs("default").create(job)
+        created.status.start_time = 1.0
+        updated = client.tpujobs("default").update_status(created)
+        assert updated.status.start_time == 1.0
+
+
+class TestWorkqueue:
+    def test_dedup_while_queued(self):
+        q = RateLimitingQueue()
+        q.add("a")
+        q.add("a")
+        assert len(q) == 1
+
+    def test_dirty_readd_while_processing(self):
+        q = RateLimitingQueue()
+        q.add("a")
+        item, _ = q.get()
+        q.add("a")  # while processing: marked dirty, not queued
+        assert len(q) == 0
+        q.done(item)
+        assert len(q) == 1
+
+    def test_rate_limited_backoff_grows(self):
+        rl = ItemExponentialFailureRateLimiter(base_delay=0.01, max_delay=1.0)
+        assert rl.when("x") == pytest.approx(0.01)
+        assert rl.when("x") == pytest.approx(0.02)
+        assert rl.when("x") == pytest.approx(0.04)
+        rl.forget("x")
+        assert rl.when("x") == pytest.approx(0.01)
+
+    def test_add_after_delivers_later(self):
+        now = [0.0]
+        q = RateLimitingQueue(clock=lambda: now[0])
+        q.add_after("a", 10.0)
+        item, _ = q.get(timeout=0)
+        assert item is None
+        now[0] = 11.0
+        item, shutdown = q.get(timeout=0)
+        assert item == "a" and not shutdown
+
+    def test_shutdown_unblocks(self):
+        q = RateLimitingQueue()
+        results = []
+
+        def getter():
+            results.append(q.get())
+
+        t = threading.Thread(target=getter)
+        t.start()
+        q.shutdown()
+        t.join(timeout=5)
+        assert results == [(None, True)]
+
+    def test_get_blocks_until_add(self):
+        q = RateLimitingQueue()
+        results = []
+        t = threading.Thread(target=lambda: results.append(q.get()))
+        t.start()
+        q.add("a")
+        t.join(timeout=5)
+        assert results == [("a", False)]
