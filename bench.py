@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Benchmark: ResNet-101 synthetic-ImageNet training throughput per TPU chip.
+
+Reference baseline: the mpi-operator README's headline number — ResNet-101
+tf_cnn_benchmarks with Horovod at ~154.2 images/sec *per GPU*
+(/root/reference/README.md:191-206, BASELINE.md).  This benchmark runs the
+same model family (ResNet-101 v1.5, batch 64+/chip, synthetic ImageNet,
+bf16) as a jit-compiled GSPMD train step and reports images/sec/chip.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_IMAGES_PER_SEC_PER_CHIP = 154.2  # reference per-GPU steady state
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--depth", type=int, default=101)
+    parser.add_argument("--batch-per-chip", type=int, default=128)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--warmup", type=int, default=5)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpi_operator_tpu.models import resnet as resnet_lib
+    from mpi_operator_tpu.parallel import create_mesh, shard_batch
+
+    devices = jax.devices()
+    n = len(devices)
+    log(f"devices: {n} x {devices[0].device_kind}")
+    mesh = create_mesh(dp=-1, devices=devices)
+
+    model = resnet_lib.resnet(args.depth)
+    rng = jax.random.PRNGKey(0)
+    params, batch_stats = resnet_lib.create_train_state(
+        model, rng, image_size=args.image_size
+    )
+    optimizer = optax.sgd(learning_rate=0.1, momentum=0.9, nesterov=True)
+    opt_state = optimizer.init(params)
+
+    # Replicate state, shard batch over dp.
+    replicated = NamedSharding(mesh, P())
+    params = jax.device_put(params, replicated)
+    batch_stats = jax.device_put(batch_stats, replicated)
+    opt_state = jax.device_put(opt_state, replicated)
+
+    global_batch = args.batch_per_chip * n
+    images = shard_batch(
+        np.random.RandomState(0)
+        .standard_normal((global_batch, args.image_size, args.image_size, 3))
+        .astype(np.float32),
+        mesh,
+    )
+    labels = shard_batch(np.random.RandomState(1).randint(0, 1000, (global_batch,)), mesh)
+
+    step = resnet_lib.make_train_step(model, optimizer)
+    step = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    log(f"compiling train step (global batch {global_batch})...")
+    t0 = time.perf_counter()
+    with mesh:
+        for _ in range(max(args.warmup, 1)):  # >=1: compile outside timing
+            params, batch_stats, opt_state, loss = step(
+                params, batch_stats, opt_state, images, labels
+            )
+        jax.block_until_ready(loss)
+        log(f"warmup done in {time.perf_counter() - t0:.1f}s; loss={float(loss):.3f}")
+
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            params, batch_stats, opt_state, loss = step(
+                params, batch_stats, opt_state, images, labels
+            )
+        jax.block_until_ready(loss)
+        elapsed = time.perf_counter() - t0
+
+    images_per_sec = global_batch * args.steps / elapsed
+    per_chip = images_per_sec / n
+    step_ms = elapsed / args.steps * 1000
+    # MFU accounting: fwd+bwd ~= 3x fwd FLOPs.
+    flops = 3 * resnet_lib.flops_per_image(args.depth, args.image_size)
+    log(
+        f"{images_per_sec:.1f} images/sec total, {per_chip:.1f}/chip, "
+        f"{step_ms:.1f} ms/step, ~{flops * per_chip / 1e12:.2f} TFLOP/s/chip"
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": f"resnet{args.depth}_images_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,12 +4,23 @@ CPU mesh; real-TPU benchmarking happens only in bench.py)."""
 
 import os
 
-# Must run before any test module imports jax. The image's sitecustomize
-# registers the 'axon' TPU platform and pins JAX_PLATFORMS=axon; tests run
-# on CPU so they are hermetic and can fake an 8-device mesh.
+# The image's sitecustomize imports jax and registers the 'axon' TPU
+# platform before this file runs, so JAX_PLATFORMS from the environment is
+# already latched — override through the config API instead.  XLA_FLAGS is
+# read at backend *creation*, which hasn't happened yet, so the env var
+# still works for the device-count override.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", (
+    "tests must run on the virtual CPU platform, got "
+    f"{jax.devices()[0].platform!r}"
+)
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"

@@ -290,7 +290,9 @@ class TPUJobController:
         namespace, name = split_key(key)
         shared = self.tpujob_informer.lister.get(namespace, name)
         if shared is None:
-            return  # deleted; dependents go via GC
+            # Deleted; dependents go via GC. Drop its info series.
+            self.job_info.remove(name + constants.LAUNCHER_SUFFIX, namespace)
+            return
         job = TPUJob.from_dict(shared)  # never mutate the cache (:475-478)
         # Baseline for change detection: the status as stored *before* this
         # sync touched anything, so condition changes made early in the sync
@@ -624,18 +626,22 @@ class TPUJobController:
             lstatus.failed = int((launcher.get("status") or {}).get("failed", 0) or 0)
             if is_job_succeeded(launcher):
                 lstatus.succeeded = 1
-                msg = f"TPUJob {job.namespace}/{job.name} successfully completed."
-                self.recorder.event(job, EVENT_TYPE_NORMAL, st.TPUJOB_SUCCEEDED_REASON, msg)
-                if job.status.completion_time is None:
-                    job.status.completion_time = (
-                        (launcher.get("status") or {}).get("completionTime") or now
+                if not st.is_succeeded(job.status):  # transition, not re-sync
+                    msg = f"TPUJob {job.namespace}/{job.name} successfully completed."
+                    self.recorder.event(
+                        job, EVENT_TYPE_NORMAL, st.TPUJOB_SUCCEEDED_REASON, msg
                     )
-                st.update_job_conditions(
-                    job, JOB_SUCCEEDED, st.TPUJOB_SUCCEEDED_REASON, msg, now=now
-                )
-                self.jobs_successful.inc()
+                    if job.status.completion_time is None:
+                        job.status.completion_time = (
+                            (launcher.get("status") or {}).get("completionTime") or now
+                        )
+                    st.update_job_conditions(
+                        job, JOB_SUCCEEDED, st.TPUJOB_SUCCEEDED_REASON, msg, now=now
+                    )
+                    self.jobs_successful.inc()
             elif is_job_failed(launcher):
-                self._update_job_failed_status(job, launcher, launcher_pods, now)
+                if not st.is_failed(job.status):
+                    self._update_job_failed_status(job, launcher, launcher_pods, now)
             else:
                 lstatus.active = running_launchers
             self.job_info.labels(launcher["metadata"]["name"], job.namespace).set(1)
@@ -658,7 +664,10 @@ class TPUJobController:
                 running += 1
                 wstatus.active += 1
 
-        if evicted > 0:
+        # Guarded on not-finished so an eviction seen in the same sync as a
+        # terminal launcher state cannot double-count or stack a second
+        # terminal condition.
+        if evicted > 0 and not st.is_finished(job.status):
             msg = f"{evicted}/{len(workers)} workers are evicted"
             st.update_job_conditions(
                 job, JOB_FAILED, st.TPUJOB_EVICTED_REASON, msg, now=now
@@ -700,7 +709,12 @@ class TPUJobController:
             # Launcher-less SPMD: worker phases drive everything.
             if replicas > 0 and running == replicas:
                 mark_running()
-            if replicas > 0 and succeeded == replicas and len(workers) == replicas:
+            if (
+                replicas > 0
+                and succeeded == replicas
+                and len(workers) == replicas
+                and not st.is_succeeded(job.status)
+            ):
                 msg = f"TPUJob {job.namespace}/{job.name} successfully completed."
                 self.recorder.event(job, EVENT_TYPE_NORMAL, st.TPUJOB_SUCCEEDED_REASON, msg)
                 if job.status.completion_time is None:
@@ -709,7 +723,7 @@ class TPUJobController:
                     job, JOB_SUCCEEDED, st.TPUJOB_SUCCEEDED_REASON, msg, now=now
                 )
                 self.jobs_successful.inc()
-            elif failed_pods and evicted == 0:
+            elif failed_pods and evicted == 0 and not st.is_finished(job.status):
                 msg = truncate_message(
                     f"TPUJob {job.namespace}/{job.name} has failed workers: "
                     + ", ".join(sorted(failed_pods))

@@ -1,0 +1,4 @@
+"""JAX/Flax example workloads — the TPU-native replacements for the
+reference's Horovod/tf_cnn_benchmarks example images (reference analog:
+/root/reference/examples/v2beta1/tensorflow-benchmarks/,
+horovod examples, pi.cc)."""

@@ -1,0 +1,188 @@
+"""ResNet v1.5 in Flax — the benchmark workload.
+
+Reference analog: the README's headline benchmark is tf_cnn_benchmarks
+ResNet-101 with Horovod allreduce (/root/reference/README.md:175-206,
+examples/v2beta1/tensorflow-benchmarks/tensorflow-benchmarks.yaml).  This
+is the same model family (v1.5: stride 2 on the 3x3 of each downsampling
+bottleneck), built TPU-first: bfloat16 compute with float32 params and
+batch stats, NHWC layouts that XLA tiles onto the MXU, and a jit-able
+train step whose gradients allreduce over mesh axes via GSPMD instead of
+Horovod/NCCL.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+# Stage layouts per depth.
+STAGE_SIZES = {
+    18: (2, 2, 2, 2),
+    34: (3, 4, 6, 3),
+    50: (3, 4, 6, 3),
+    101: (3, 4, 23, 3),
+    152: (3, 8, 36, 3),
+}
+BOTTLENECK = {18: False, 34: False, 50: True, 101: True, 152: True}
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides),
+                name="conv_proj",
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), strides=(self.strides, self.strides),
+                name="conv_proj",
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    bottleneck: bool = True
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )
+        block = BottleneckBlock if self.bottleneck else BasicBlock
+
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                 name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = block(
+                    filters=self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    act=nn.relu,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def resnet(depth: int, num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(
+        stage_sizes=STAGE_SIZES[depth],
+        bottleneck=BOTTLENECK[depth],
+        num_classes=num_classes,
+        dtype=dtype,
+    )
+
+
+resnet50 = partial(resnet, 50)
+resnet101 = partial(resnet, 101)
+
+
+def flops_per_image(depth: int, image_size: int = 224) -> float:
+    """Approximate fwd FLOPs/image (for MFU accounting). Standard figures:
+    ResNet-50 ~4.1e9, ResNet-101 ~7.8e9 at 224x224; scale quadratically."""
+    base = {18: 1.8e9, 34: 3.7e9, 50: 4.1e9, 101: 7.8e9, 152: 11.5e9}[depth]
+    return base * (image_size / 224) ** 2
+
+
+def create_train_state(model: ResNet, rng, image_size: int = 224, batch: int = 8):
+    """Init params + batch stats with a dummy batch."""
+    variables = model.init(
+        rng, jnp.zeros((batch, image_size, image_size, 3), jnp.float32), train=True
+    )
+    return variables["params"], variables["batch_stats"]
+
+
+def make_train_step(model: ResNet, optimizer):
+    """Build a jit-able SGD train step: (params, batch_stats, opt_state,
+    images, labels) -> (params, batch_stats, opt_state, loss).
+
+    Under a mesh, GSPMD turns the gradient reduction into an allreduce over
+    the batch-sharded axes — the Horovod `--variable_update=horovod` analog
+    with zero lines of communication code.
+    """
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            images,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        one_hot = jax.nn.one_hot(labels, logits.shape[-1])
+        loss = -jnp.mean(jnp.sum(one_hot * jax.nn.log_softmax(logits), axis=-1))
+        return loss, updates["batch_stats"]
+
+    def train_step(params, batch_stats, opt_state, images, labels):
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch_stats, images, labels
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        import optax
+
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, loss
+
+    return train_step

@@ -1,0 +1,17 @@
+"""Device-mesh construction and GSPMD sharding rules.
+
+The reference operator never inspects tensor layouts (SURVEY.md §2.4);
+parallelism lives in user programs.  In this framework the same layering
+holds — the *operator* hands out topology (TPU_WORKER_* env), and this
+package turns that topology into ``jax.sharding.Mesh`` axes + partition
+specs for the example workloads: dp (data), fsdp (ZeRO-style parameter
+sharding), tp (tensor/model), sp (sequence/context).
+"""
+
+from .mesh import MeshConfig, create_mesh, local_batch_size  # noqa: F401
+from .sharding import (  # noqa: F401
+    batch_spec,
+    fsdp_param_spec,
+    shard_batch,
+    shard_params,
+)

@@ -1,0 +1,113 @@
+"""Mesh construction.
+
+Axis convention (outer → inner, so the innermost axes map to ICI
+neighbors and the outermost to DCN hops — multislice jobs put ``dp``
+across slices):
+
+    ('dp', 'fsdp', 'tp', 'sp')
+
+Any subset may be used; sizes multiply to the device count.  A size of
+``-1`` means "whatever is left" (at most one axis).
+
+Note: built with the classic ``jax.sharding.Mesh`` constructor so the
+axes are *Auto* — GSPMD propagates shardings and inserts collectives.
+(``jax.make_mesh`` in jax 0.9 defaults to Explicit axis types, which
+demands per-op out_shardings; that mode is not what these workloads use.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from math import prod
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+DP = "dp"
+FSDP = "fsdp"
+TP = "tp"
+SP = "sp"
+
+STANDARD_AXES = (DP, FSDP, TP, SP)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Named axis sizes, resolved against a device count."""
+
+    axes: tuple[tuple[str, int], ...]
+
+    @classmethod
+    def of(cls, **sizes: int) -> "MeshConfig":
+        return cls(tuple(sizes.items()))
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        sizes = dict(self.axes)
+        wild = [name for name, size in sizes.items() if size == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {wild}")
+        fixed = prod(size for size in sizes.values() if size != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"device count {n_devices} not divisible by fixed axes {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh axes {dict(self.axes)} require {fixed} devices, have {n_devices}"
+            )
+        return MeshConfig(tuple(sizes.items()))
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(name for name, _ in self.axes)
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return tuple(size for _, size in self.axes)
+
+
+def create_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence] = None,
+    **sizes: int,
+):
+    """Build a Mesh. ``create_mesh(dp=-1)``, ``create_mesh(dp=2, tp=4)``...
+
+    Defaults to pure data parallelism over all visible devices.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if config is None:
+        config = MeshConfig.of(**sizes) if sizes else MeshConfig.of(dp=-1)
+    devices = list(devices if devices is not None else jax.devices())
+    config = config.resolve(len(devices))
+    dev_array = np.asarray(devices).reshape(config.shape)
+    return Mesh(dev_array, config.names)
+
+
+def local_batch_size(global_batch: int, mesh) -> int:
+    """Per-process slice of the global batch for data loading.
+
+    Every process loads ``global_batch / process_count`` examples (the
+    ``jax.make_array_from_process_local_data`` contract); the global batch
+    must also divide evenly over the batch-sharded mesh axes (dp x fsdp).
+    """
+    import jax
+
+    n_batch_shards = 1
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    for axis in (DP, FSDP):
+        n_batch_shards *= sizes.get(axis, 1)
+    if global_batch % n_batch_shards:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by dp x fsdp = {n_batch_shards}"
+        )
+    n_proc = jax.process_count()
+    if global_batch % n_proc:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by process count {n_proc}"
+        )
+    return global_batch // n_proc

@@ -1,0 +1,89 @@
+"""GSPMD sharding rules for params and batches.
+
+The scaling-book recipe: pick a mesh, annotate inputs/params with
+PartitionSpecs, let XLA insert the collectives.  These helpers produce
+the annotations; nothing here issues a collective by hand.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .mesh import DP, FSDP, SP, TP
+
+
+def batch_spec(mesh, *, sequence_axis: Optional[int] = None):
+    """PartitionSpec for a batch array: batch dim over dp+fsdp, optional
+    sequence dim over sp (context parallelism)."""
+    from jax.sharding import PartitionSpec as P
+
+    names = set(mesh.axis_names)
+    batch_axes = tuple(a for a in (DP, FSDP) if a in names)
+    dims: list = [batch_axes if batch_axes else None]
+    if sequence_axis is not None:
+        while len(dims) < sequence_axis:
+            dims.append(None)
+        dims.append(SP if SP in names else None)
+    return P(*dims)
+
+
+def fsdp_param_spec(shape: tuple[int, ...], mesh, *, min_size: int = 2**14):
+    """ZeRO-3-style parameter spec: shard the largest divisible dim over
+    ``fsdp``; small params stay replicated (sharding them costs more in
+    collective latency than it saves in HBM)."""
+    from jax.sharding import PartitionSpec as P
+
+    names = set(mesh.axis_names)
+    if FSDP not in names or not shape:
+        return P()
+    fsdp_size = dict(zip(mesh.axis_names, mesh.devices.shape))[FSDP]
+    size = 1
+    for d in shape:
+        size *= d
+    if size < min_size:
+        return P()
+    # Largest dim divisible by the fsdp axis size wins.
+    candidates = [
+        (dim_size, i) for i, dim_size in enumerate(shape) if dim_size % fsdp_size == 0
+    ]
+    if not candidates:
+        return P()
+    _, index = max(candidates)
+    dims: list = [None] * len(shape)
+    dims[index] = FSDP
+    return P(*dims)
+
+
+def shard_params(params, mesh, *, rules=None):
+    """Place a pytree of params on the mesh.
+
+    ``rules`` maps a path-predicate to a PartitionSpec override (used by
+    models that declare tp/sp layouts); unmatched leaves get the FSDP
+    heuristic.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    def place(path, leaf):
+        spec = None
+        if rules:
+            path_str = "/".join(str(getattr(k, "key", k)) for k in path)
+            for predicate, rule_spec in rules:
+                if predicate(path_str, leaf):
+                    spec = rule_spec
+                    break
+        if spec is None:
+            spec = fsdp_param_spec(getattr(leaf, "shape", ()), mesh)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def shard_batch(batch, mesh, *, sequence_axis: Optional[int] = None):
+    """Place batch arrays on the mesh with `batch_spec`."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, batch_spec(mesh, sequence_axis=sequence_axis))
+
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)

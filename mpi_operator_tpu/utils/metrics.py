@@ -66,6 +66,11 @@ class Gauge(_Metric):
         with self._lock:
             self._values[labels] = value
 
+    def remove(self, *labels: str) -> None:
+        """Drop a label set (prevents unbounded stale series)."""
+        with self._lock:
+            self._values.pop(labels, None)
+
     def value(self, *labels: str) -> float:
         with self._lock:
             return self._values.get(labels, 0.0)

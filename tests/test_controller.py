@@ -544,3 +544,35 @@ class TestMultisliceEnv:
         assert env["TPUJOB_PROCESS_ID"] == "5"
         assert env["TPUJOB_NUM_PROCESSES"] == "8"
         assert env["TPUJOB_NUM_SLICES"] == "2"
+
+
+class TestTerminalStatusGuards:
+    def test_eviction_with_finished_launcher_counts_once(self):
+        f = Fixture()
+        job = make_synced_job(f, launcher=True)
+        f.set_pod_phase("test-job-worker-1", "Failed", reason="Evicted")
+        f.mark_launcher(job, "Failed", reason="BackoffLimitExceeded")
+        f.sync(job)
+        job = f.get_job()
+        assert st.is_failed(job.status)
+        assert f.controller.jobs_failed.value() == 1  # not double-counted
+
+    def test_succeeded_launcher_with_evicted_worker_not_contradictory(self):
+        f = Fixture()
+        job = make_synced_job(f, launcher=True)
+        f.set_pod_phase("test-job-worker-1", "Failed", reason="Evicted")
+        f.mark_launcher(job, "Complete")
+        f.sync(job)
+        job = f.get_job()
+        assert st.is_succeeded(job.status)
+        assert not st.is_failed(job.status)
+        assert f.controller.jobs_failed.value() == 0
+
+    def test_job_info_gauge_cleared_on_delete(self):
+        f = Fixture()
+        job = make_synced_job(f, launcher=True)
+        assert f.controller.job_info.value("test-job-launcher", "default") == 1
+        f.api.delete("tpujobs", "default", "test-job")
+        f.controller.factory.pump_until_quiet()
+        f.controller.sync_handler("default/test-job")
+        assert f.controller.job_info.value("test-job-launcher", "default") == 0

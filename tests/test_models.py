@@ -1,0 +1,80 @@
+"""Model tests (tiny shapes, CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from mpi_operator_tpu.models import resnet as resnet_lib
+
+
+@pytest.fixture(scope="module")
+def tiny_resnet():
+    model = resnet_lib.resnet(18, num_classes=16, dtype=jnp.float32)
+    params, batch_stats = resnet_lib.create_train_state(
+        model, jax.random.PRNGKey(0), image_size=32, batch=2
+    )
+    return model, params, batch_stats
+
+
+class TestResNet:
+    def test_forward_shape(self, tiny_resnet):
+        model, params, batch_stats = tiny_resnet
+        x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+        logits = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=False
+        )
+        assert logits.shape == (2, 16)
+        assert logits.dtype == jnp.float32
+
+    def test_bottleneck_depths(self):
+        # ResNet-50 param count ~25.5M; structural sanity via param count.
+        model = resnet_lib.resnet50()
+        params, _ = resnet_lib.create_train_state(
+            model, jax.random.PRNGKey(0), image_size=64, batch=1
+        )
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        assert 25e6 < n_params < 26e6
+
+    def test_train_step_learns(self, tiny_resnet):
+        model, params, batch_stats = tiny_resnet
+        optimizer = optax.sgd(0.05, momentum=0.9)
+        opt_state = optimizer.init(params)
+        step = jax.jit(resnet_lib.make_train_step(model, optimizer))
+        images = np.random.RandomState(0).standard_normal((8, 32, 32, 3)).astype(
+            np.float32
+        )
+        labels = np.random.RandomState(1).randint(0, 16, (8,))
+        first_loss = None
+        for _ in range(5):
+            params, batch_stats, opt_state, loss = step(
+                params, batch_stats, opt_state, images, labels
+            )
+            if first_loss is None:
+                first_loss = float(loss)
+        assert jnp.isfinite(loss)
+        assert float(loss) < first_loss  # overfits a fixed batch
+
+
+class TestGraftEntry:
+    def test_dryrun_multichip(self):
+        import __graft_entry__
+
+        __graft_entry__.dryrun_multichip(8)
+
+    def test_entry_compiles_tiny(self):
+        # entry() itself builds ResNet-101 (slow on CPU); compile-check the
+        # same code path with a small model instead.
+        model = resnet_lib.resnet(18, num_classes=8, dtype=jnp.float32)
+        params, batch_stats = resnet_lib.create_train_state(
+            model, jax.random.PRNGKey(0), image_size=32, batch=1
+        )
+
+        def forward(params, batch_stats, images):
+            return model.apply(
+                {"params": params, "batch_stats": batch_stats}, images, train=False
+            )
+
+        out = jax.jit(forward)(params, batch_stats, jnp.zeros((1, 32, 32, 3)))
+        assert out.shape == (1, 8)

@@ -1,0 +1,96 @@
+"""Mesh + sharding tests on the virtual 8-device CPU platform."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mpi_operator_tpu.parallel import (
+    MeshConfig,
+    batch_spec,
+    create_mesh,
+    fsdp_param_spec,
+    shard_batch,
+    shard_params,
+)
+
+
+class TestMeshConfig:
+    def test_resolve_wildcard(self):
+        cfg = MeshConfig.of(dp=2, fsdp=-1).resolve(8)
+        assert dict(cfg.axes) == {"dp": 2, "fsdp": 4}
+
+    def test_resolve_exact(self):
+        cfg = MeshConfig.of(dp=8).resolve(8)
+        assert cfg.shape == (8,)
+
+    def test_mismatch_raises(self):
+        with pytest.raises(ValueError, match="require"):
+            MeshConfig.of(dp=3).resolve(8)
+
+    def test_two_wildcards_raise(self):
+        with pytest.raises(ValueError, match="at most one"):
+            MeshConfig.of(dp=-1, tp=-1).resolve(8)
+
+
+class TestCreateMesh:
+    def test_default_is_pure_dp(self):
+        mesh = create_mesh()
+        assert mesh.axis_names == ("dp",)
+        assert mesh.devices.shape == (8,)
+
+    def test_dp_fsdp(self):
+        mesh = create_mesh(dp=2, fsdp=4)
+        assert mesh.devices.shape == (2, 4)
+        # Auto axis types: GSPMD mode, not explicit sharding-in-types.
+        assert all("Auto" in str(t) for t in mesh.axis_types)
+
+
+class TestShardingSpecs:
+    def test_batch_spec_combines_dp_fsdp(self):
+        mesh = create_mesh(dp=2, fsdp=4)
+        assert batch_spec(mesh) == P(("dp", "fsdp"))
+
+    def test_batch_spec_with_sequence_axis(self):
+        mesh = create_mesh(dp=2, sp=4)
+        assert batch_spec(mesh, sequence_axis=1) == P("dp", "sp")
+
+    def test_fsdp_spec_shards_largest_divisible_dim(self):
+        mesh = create_mesh(dp=2, fsdp=4)
+        assert fsdp_param_spec((512, 256), mesh) == P("fsdp", None)
+        assert fsdp_param_spec((256, 512), mesh) == P(None, "fsdp")
+
+    def test_small_params_replicated(self):
+        mesh = create_mesh(fsdp=8)
+        assert fsdp_param_spec((64,), mesh) == P()
+
+    def test_indivisible_replicated(self):
+        mesh = create_mesh(fsdp=8)
+        assert fsdp_param_spec((129, 131), mesh) == P()
+
+    def test_no_fsdp_axis_replicates(self):
+        mesh = create_mesh(dp=8)
+        assert fsdp_param_spec((1024, 1024), mesh) == P()
+
+
+class TestPlacement:
+    def test_shard_params_places_leaves(self):
+        mesh = create_mesh(dp=2, fsdp=4)
+        params = {"w": np.zeros((512, 128), np.float32), "b": np.zeros((8,), np.float32)}
+        placed = shard_params(params, mesh)
+        assert placed["w"].sharding.spec == P("fsdp", None)
+        assert placed["b"].sharding.spec == P()
+
+    def test_shard_batch(self):
+        mesh = create_mesh(dp=2, fsdp=4)
+        batch = shard_batch(np.zeros((16, 4), np.float32), mesh)
+        assert batch.sharding.spec == P(("dp", "fsdp"))
+
+    def test_sharded_matmul_runs(self):
+        mesh = create_mesh(dp=2, fsdp=4)
+        x = shard_batch(np.ones((16, 64), np.float32), mesh)
+        w = shard_params({"w": np.ones((64, 32), np.float32)}, mesh)["w"]
+        with mesh:
+            y = jax.jit(lambda x, w: x @ w)(x, w)
+        assert y.shape == (16, 32)
+        assert float(y[0, 0]) == 64.0
