@@ -38,6 +38,7 @@ from ..api.v2beta1.types import (
     KIND,
     REPLICA_TYPE_LAUNCHER,
     REPLICA_TYPE_WORKER,
+    ReplicaStatus,
     TPUJob,
 )
 from ..runtime.apiserver import InMemoryAPIServer, NotFoundError
@@ -342,7 +343,14 @@ class TPUJobController:
         if st.is_finished(job.status) and job.status.completion_time is not None:
             if job.spec.run_policy.clean_pod_policy in ("Running", "All"):
                 self._delete_worker_pods(job)
-                st.initialize_replica_statuses(job, REPLICA_TYPE_WORKER)
+                # Unlike the reference (:516-518, which wipes the whole
+                # worker ReplicaStatus), keep the terminal counts and only
+                # zero the active counts — the final status should still say
+                # how many replicas succeeded/failed.
+                for rtype in job.spec.replica_specs:
+                    job.status.replica_statuses.setdefault(
+                        rtype, ReplicaStatus()
+                    ).active = 0
                 if self.gang_scheduler_name:
                     self._delete_pod_groups(job)
                 if job.status.to_dict() != old_status:

@@ -18,6 +18,7 @@ from typing import Any, Callable, Sequence
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import optax
 
 ModuleDef = Any
 
@@ -180,8 +181,6 @@ def make_train_step(model: ResNet, optimizer):
             params, batch_stats, images, labels
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        import optax
-
         params = optax.apply_updates(params, updates)
         return params, new_stats, opt_state, loss
 

@@ -82,6 +82,12 @@ def create_mesh(
 
     if config is None:
         config = MeshConfig.of(**sizes) if sizes else MeshConfig.of(dp=-1)
+    # Canonicalize axis order to the documented outer->inner convention so
+    # kwargs order can never flip which axis lands on DCN vs ICI.
+    known = [a for a in STANDARD_AXES if a in dict(config.axes)]
+    extra = [a for a, _ in config.axes if a not in STANDARD_AXES]
+    order = known + extra
+    config = MeshConfig(tuple((a, dict(config.axes)[a]) for a in order))
     devices = list(devices if devices is not None else jax.devices())
     config = config.resolve(len(devices))
     dev_array = np.asarray(devices).reshape(config.shape)

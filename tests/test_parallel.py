@@ -94,3 +94,10 @@ class TestPlacement:
             y = jax.jit(lambda x, w: x @ w)(x, w)
         assert y.shape == (16, 32)
         assert float(y[0, 0]) == 64.0
+
+
+class TestAxisOrderCanonicalization:
+    def test_kwargs_order_cannot_flip_axes(self):
+        a = create_mesh(fsdp=4, dp=2)
+        b = create_mesh(dp=2, fsdp=4)
+        assert a.axis_names == b.axis_names == ("dp", "fsdp")
