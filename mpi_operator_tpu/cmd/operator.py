@@ -1,0 +1,232 @@
+"""The operator process.
+
+Reference analog: /root/reference/v2/cmd/mpi-operator/ — flags
+(app/options/options.go:45-71), CRD preflight (server.go:287-299), leader
+election (server.go:210-257), /healthz (:192-208), Prometheus /metrics
+(main.go:29-40), then the controller run loop.
+
+Backends: ``--backend memory`` boots the in-memory API server with the
+LocalPodRunner kubelet sim (a self-contained "cluster in a process" —
+useful for demos and as the integration surface); a real-cluster REST
+backend slots in behind the same InMemoryAPIServer interface.
+
+Run:  python -m mpi_operator_tpu.cmd.operator --help
+      python -m mpi_operator_tpu.cmd.operator --backend memory \
+          --apply examples/v2beta1/pi/pi.yaml --exit-on-completion
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import __version__
+from ..api.v2beta1 import constants
+from ..controller import status as st
+from ..controller.tpu_job_controller import TPUJobController
+from ..runtime.apiserver import RESOURCES, InMemoryAPIServer
+from ..runtime.leaderelection import LeaderElectionConfig, LeaderElector
+from ..runtime.podrunner import LocalPodRunner
+from ..utils import metrics
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-operator",
+        description="TPU-native job operator (TPUJob kubeflow.org/v2beta1)",
+    )
+    # options.go:45-71 analogs.
+    p.add_argument(
+        "--namespace",
+        default=os.environ.get(constants.ENV_KUBEFLOW_NAMESPACE, ""),
+        help="namespace to watch (empty = all namespaces)",
+    )
+    p.add_argument("--threadiness", type=int, default=2, help="worker goroutine count")
+    p.add_argument("--monitoring-port", type=int, default=0,
+                   help="port for /metrics + /healthz (0 = disabled)")
+    p.add_argument("--gang-scheduling", default="",
+                   help="gang scheduler name (e.g. volcano); empty disables")
+    p.add_argument("--leader-elect", action="store_true",
+                   help="enable leader election for HA deployments")
+    p.add_argument("--lock-namespace", default="default",
+                   help="namespace of the leader-election Lease")
+    p.add_argument("--backend", choices=["memory"], default="memory",
+                   help="cluster backend (memory = in-process apiserver + kubelet sim)")
+    p.add_argument("--apply", action="append", default=[],
+                   help="TPUJob YAML file(s) to apply at startup")
+    p.add_argument("--exit-on-completion", action="store_true",
+                   help="exit once every applied TPUJob is finished")
+    p.add_argument("--version", action="version",
+                   version=f"tpu-operator {__version__}")
+    return p
+
+
+class _MonitoringHandler(BaseHTTPRequestHandler):
+    registry: metrics.Registry = None
+    health_fn = staticmethod(lambda: True)
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/metrics":
+            body = self.registry.expose().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+        elif self.path == "/healthz":
+            ok = self.health_fn()
+            body = b"ok" if ok else b"unhealthy"
+            self.send_response(200 if ok else 500)
+            self.send_header("Content-Type", "text/plain")
+        else:
+            body = b"not found"
+            self.send_response(404)
+            self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+def start_monitoring(port: int, registry: metrics.Registry, health_fn):
+    """startMonitoring (main.go:29-40) + healthz server (:192-208) analog."""
+    handler = type(
+        "Handler",
+        (_MonitoringHandler,),
+        {"registry": registry, "health_fn": staticmethod(health_fn)},
+    )
+    server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server
+
+
+def check_crd_exists() -> None:
+    """CRD preflight (server.go:287-299 analog): fail fast if the TPUJob
+    resource is not served."""
+    if "tpujobs" not in RESOURCES:
+        print(
+            "CRD tpujobs.kubeflow.org not served; install the CRD first",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+
+
+def run(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    check_crd_exists()
+
+    api = InMemoryAPIServer()
+    registry = metrics.Registry()
+    is_leader = metrics.new_gauge(
+        "tpu_operator_is_leader", "1 if this replica is the leader", (), registry
+    )
+    controller = TPUJobController(
+        api, gang_scheduler_name=args.gang_scheduling, registry=registry
+    )
+    # Controller metrics share the exposed registry.
+    runner = LocalPodRunner(api)
+    runner.start()
+
+    applied: list[tuple[str, str]] = []
+    import yaml
+
+    for path in args.apply:
+        with open(path) as f:
+            for doc in yaml.safe_load_all(f):
+                if not doc:
+                    continue
+                doc.setdefault("metadata", {}).setdefault(
+                    "namespace", args.namespace or "default"
+                )
+                created = api.create("tpujobs", doc)
+                applied.append(
+                    (created["metadata"]["namespace"], created["metadata"]["name"])
+                )
+                print(f"applied TPUJob {applied[-1][0]}/{applied[-1][1]}")
+
+    stop = threading.Event()
+
+    def lead(lost: threading.Event) -> None:
+        is_leader.set(1)
+        local_stop = threading.Event()
+
+        def forward():
+            # stop when either leadership is lost or the process stops
+            while not (lost.is_set() or stop.is_set()):
+                time.sleep(0.05)
+            local_stop.set()
+
+        threading.Thread(target=forward, daemon=True).start()
+        controller.run(threadiness=args.threadiness, stop=local_stop)
+
+    threads = []
+    elector = None
+    if args.leader_elect:
+        elector = LeaderElector(
+            api,
+            LeaderElectionConfig(
+                lock_namespace=args.lock_namespace,
+                identity=f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}",
+            ),
+            on_started_leading=lead,
+            on_stopped_leading=lambda: is_leader.set(0),
+        )
+        threads.append(threading.Thread(target=elector.run, args=(stop,), daemon=True))
+    else:
+        is_leader.set(1)
+        threads.append(
+            threading.Thread(
+                target=lambda: controller.run(args.threadiness, stop), daemon=True
+            )
+        )
+
+    # Monitoring starts after the elector exists so /healthz can never race
+    # against a half-initialized process.
+    if args.monitoring_port:
+        health = elector.healthy if elector is not None else (lambda: True)
+        start_monitoring(args.monitoring_port, registry, health)
+        print(f"monitoring on http://127.0.0.1:{args.monitoring_port}/metrics")
+
+    for t in threads:
+        t.start()
+
+    try:
+        while not stop.is_set():
+            if args.exit_on_completion and applied:
+                finals = []
+                for ns, name in applied:
+                    job = api.get("tpujobs", ns, name)
+                    terminal = [
+                        c
+                        for c in (job.get("status") or {}).get("conditions") or []
+                        if c["status"] == "True" and c["type"] in ("Succeeded", "Failed")
+                    ]
+                    finals.append((ns, name, terminal[-1] if terminal else None))
+                if all(final is not None for _, _, final in finals):
+                    for ns, name, final in finals:
+                        print(
+                            f"TPUJob {ns}/{name}: {final['type']} ({final.get('reason', '')})"
+                        )
+                    stop.set()
+                    runner.stop()
+                    return 0 if all(f["type"] == "Succeeded" for _, _, f in finals) else 1
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        stop.set()
+    runner.stop()
+    return 0
+
+
+def main() -> int:
+    return run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -223,8 +223,14 @@ class TPUJobController:
         self.factory.start_all()
 
     def run(self, threadiness: int = 2, stop: Optional[threading.Event] = None) -> None:
-        """Run(threadiness, stopCh) :355-377 analog (blocking)."""
+        """Run(threadiness, stopCh) :355-377 analog (blocking).
+
+        Re-entrant across leadership terms: a queue shut down by a previous
+        term's stop is re-armed here.
+        """
         stop = stop or threading.Event()
+        if self.queue.is_shutdown:
+            self.queue.reset()
         self.start()
 
         def pump_loop():

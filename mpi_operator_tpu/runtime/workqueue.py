@@ -152,6 +152,11 @@ class RateLimitingQueue:
             self._shutdown = True
             self._cond.notify_all()
 
+    def reset(self) -> None:
+        """Re-arm a shut-down queue (leadership regained after step-down)."""
+        with self._cond:
+            self._shutdown = False
+
     @property
     def is_shutdown(self) -> bool:
         with self._cond:

@@ -1,0 +1,61 @@
+"""Operator CLI smoke tests (subprocess, memory backend)."""
+
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def run_cli(*args, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi_operator_tpu.cmd.operator", *args],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+class TestOperatorCLI:
+    def test_version(self):
+        out = run_cli("--version", timeout=30)
+        assert out.returncode == 0
+        assert "tpu-operator" in out.stdout
+
+    def test_apply_and_run_to_completion(self, tmp_path):
+        # Pin a test-private coordinator port so a lingering worker from a
+        # concurrent run can never squat the default port.
+        import yaml
+
+        doc = yaml.safe_load((REPO / "examples/v2beta1/pi/pi.yaml").read_text())
+        doc["spec"]["jaxDistribution"] = {"coordinatorPort": 8701}
+        path = tmp_path / "pi.yaml"
+        path.write_text(yaml.safe_dump(doc))
+        out = run_cli("--apply", str(path), "--exit-on-completion")
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "Succeeded" in out.stdout
+
+    def test_failed_job_exits_nonzero(self, tmp_path):
+        bad = tmp_path / "bad.yaml"
+        bad.write_text(
+            """
+apiVersion: kubeflow.org/v2beta1
+kind: TPUJob
+metadata: {name: bad}
+spec:
+  tpu: {acceleratorType: v5p-8}
+  jaxDistribution: {coordinatorPort: 8702}
+  tpuReplicaSpecs:
+    Worker:
+      template:
+        spec:
+          containers:
+          - name: main
+            image: img
+            command: [python, -c, "raise SystemExit(9)"]
+"""
+        )
+        out = run_cli("--apply", str(bad), "--exit-on-completion")
+        assert out.returncode == 1
+        assert "Failed" in out.stdout

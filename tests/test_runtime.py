@@ -260,3 +260,13 @@ class TestWorkqueue:
         q.add("a")
         t.join(timeout=5)
         assert results == [("a", False)]
+
+
+class TestQueueReset:
+    def test_reset_rearms_after_shutdown(self):
+        q = RateLimitingQueue()
+        q.shutdown()
+        assert q.get(timeout=0) == (None, True)
+        q.reset()
+        q.add("a")
+        assert q.get(timeout=1) == ("a", False)
