@@ -1,0 +1,341 @@
+#!/usr/bin/env python3
+"""Generate deployment manifests from the Python API types.
+
+controller-gen analog (reference: `make crd` -> v2/crd/kubeflow.org_mpijobs.yaml,
+Makefile:148-150): emits the TPUJob CRD with a structural OpenAPI v3 schema
+derived from mpi_operator_tpu.api.v2beta1.types, then assembles the flat
+single-file installer (reference analog: deploy/v2beta1/mpi-operator.yaml)
+from the kustomize base.
+
+Run from the repo root:  python hack/gen_manifests.py
+Verify (CI):             python hack/gen_manifests.py --verify
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import pathlib
+import sys
+
+import yaml
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from mpi_operator_tpu.api.v2beta1 import constants, types  # noqa: E402
+
+
+def _str(desc: str = "", **kw) -> dict:
+    d = {"type": "string"}
+    if desc:
+        d["description"] = desc
+    d.update(kw)
+    return d
+
+
+def _int(desc: str = "", minimum=None, maximum=None) -> dict:
+    d: dict = {"type": "integer", "format": "int32"}
+    if desc:
+        d["description"] = desc
+    if minimum is not None:
+        d["minimum"] = minimum
+    if maximum is not None:
+        d["maximum"] = maximum
+    return d
+
+
+def replica_spec_schema(role: str) -> dict:
+    return {
+        "type": "object",
+        "description": f"{role} replica group.",
+        "properties": {
+            "replicas": _int(
+                "Number of replicas. For Worker this is normally derived "
+                "from spec.tpu and may be omitted.",
+                minimum=0,
+            ),
+            "restartPolicy": _str(
+                "Restart policy for replica pods.",
+                enum=[types.RESTART_POLICY_NEVER, types.RESTART_POLICY_ON_FAILURE],
+            ),
+            "template": {
+                "type": "object",
+                "description": "core/v1 PodTemplateSpec for the replica pods.",
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+        },
+    }
+
+
+def job_spec_schema() -> dict:
+    return {
+        "type": "object",
+        "required": ["tpuReplicaSpecs"],
+        "properties": {
+            "tpu": {
+                "type": "object",
+                "description": (
+                    "The TPU slice shape this job trains on. Worker count and "
+                    "chips-per-pod are derived from acceleratorType/topology."
+                ),
+                "properties": {
+                    "acceleratorType": _str(
+                        "TPU slice type, <generation>-<chips>, e.g. v5e-16.",
+                        pattern=r"^v[0-9]+[a-z]*-[0-9]+$",
+                    ),
+                    "topology": _str(
+                        "Optional explicit chip topology, e.g. 4x4 or 2x2x4.",
+                        pattern=r"^[0-9]+(x[0-9]+)*$",
+                    ),
+                    "numSlices": _int(
+                        "Number of pod slices (>1 = multislice over DCN).",
+                        minimum=1,
+                    ),
+                    "runtimeVersion": _str("TPU VM runtime version label."),
+                },
+            },
+            "jaxDistribution": {
+                "type": "object",
+                "description": (
+                    "Rendezvous wiring for jax.distributed.initialize. "
+                    "Replaces the reference operator's SSH bootstrap: the only "
+                    "shared state is worker-0's coordinator address."
+                ),
+                "properties": {
+                    "coordinatorPort": _int(
+                        "Coordinator port on worker 0.", minimum=1, maximum=65535
+                    ),
+                    "heartbeatTimeoutSeconds": _int(
+                        "jax.distributed heartbeat timeout.", minimum=1
+                    ),
+                },
+            },
+            "runPolicy": {
+                "type": "object",
+                "description": "Policies for job lifetime and cleanup.",
+                "properties": {
+                    "cleanPodPolicy": _str(
+                        "Which worker pods to delete once the job finishes.",
+                        enum=[
+                            types.CLEAN_POD_POLICY_NONE,
+                            types.CLEAN_POD_POLICY_RUNNING,
+                            types.CLEAN_POD_POLICY_ALL,
+                        ],
+                    ),
+                    "ttlSecondsAfterFinished": _int(minimum=0),
+                    "activeDeadlineSeconds": _int(minimum=0),
+                    "backoffLimit": _int(minimum=0),
+                    "suspend": {
+                        "type": "boolean",
+                        "description": "Suspend gates worker/launcher creation.",
+                    },
+                    "schedulingPolicy": {
+                        "type": "object",
+                        "properties": {
+                            "minAvailable": _int(minimum=0),
+                            "queue": _str(),
+                            "priorityClass": _str(),
+                        },
+                    },
+                },
+            },
+            "tpuReplicaSpecs": {
+                "type": "object",
+                "required": [types.REPLICA_TYPE_WORKER],
+                "properties": {
+                    types.REPLICA_TYPE_LAUNCHER: replica_spec_schema("Launcher"),
+                    types.REPLICA_TYPE_WORKER: replica_spec_schema("Worker"),
+                },
+            },
+        },
+    }
+
+
+def job_status_schema() -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "conditions": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "required": ["type", "status"],
+                    "properties": {
+                        "type": _str(
+                            enum=[
+                                types.JOB_CREATED,
+                                types.JOB_RUNNING,
+                                types.JOB_RESTARTING,
+                                types.JOB_SUSPENDED,
+                                types.JOB_SUCCEEDED,
+                                types.JOB_FAILED,
+                            ]
+                        ),
+                        "status": _str(enum=["True", "False", "Unknown"]),
+                        "reason": _str(),
+                        "message": _str(),
+                        "lastUpdateTime": {"type": "number"},
+                        "lastTransitionTime": {"type": "number"},
+                    },
+                },
+            },
+            "replicaStatuses": {
+                "type": "object",
+                "additionalProperties": {
+                    "type": "object",
+                    "properties": {
+                        "active": _int(minimum=0),
+                        "succeeded": _int(minimum=0),
+                        "failed": _int(minimum=0),
+                    },
+                },
+            },
+            "startTime": {"type": "number"},
+            "completionTime": {"type": "number"},
+            "lastReconcileTime": {"type": "number"},
+        },
+    }
+
+
+def build_crd() -> dict:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {
+            "name": f"{types.PLURAL}.{types.GROUP_NAME}",
+            "annotations": {"api-approved.kubernetes.io": "unapproved, experimental"},
+        },
+        "spec": {
+            "group": types.GROUP_NAME,
+            "scope": "Namespaced",
+            "names": {
+                "kind": types.KIND,
+                "listKind": f"{types.KIND}List",
+                "plural": types.PLURAL,
+                "singular": types.KIND.lower(),
+                "shortNames": ["tj"],
+            },
+            "versions": [
+                {
+                    "name": types.GROUP_VERSION,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "additionalPrinterColumns": [
+                        {
+                            "name": "Accelerator",
+                            "type": "string",
+                            "jsonPath": ".spec.tpu.acceleratorType",
+                        },
+                        {
+                            "name": "State",
+                            "type": "string",
+                            "jsonPath": ".status.conditions[-1:].type",
+                        },
+                        {
+                            "name": "Age",
+                            "type": "date",
+                            "jsonPath": ".metadata.creationTimestamp",
+                        },
+                    ],
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "apiVersion": _str(),
+                                "kind": _str(),
+                                "metadata": {"type": "object"},
+                                "spec": job_spec_schema(),
+                                "status": job_status_schema(),
+                            },
+                        }
+                    },
+                }
+            ],
+        },
+    }
+
+
+HEADER = (
+    "# Generated by hack/gen_manifests.py from "
+    "mpi_operator_tpu/api/v2beta1/types.py — DO NOT EDIT.\n"
+)
+
+
+def dump(doc) -> str:
+    return yaml.safe_dump(doc, sort_keys=False, width=88)
+
+
+def flat_installer(base: pathlib.Path, crd_text: str) -> str:
+    """deploy/v2beta1/mpi-operator.yaml analog: namespace + base resources."""
+    namespace = {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": "tpu-operator"},
+    }
+    # The ConfigMap kustomize would generate from params.env; the flat
+    # installer is self-contained in its own namespace.
+    config = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": "tpu-operator-config", "namespace": "tpu-operator"},
+        "data": {"lock-namespace": "tpu-operator"},
+    }
+    out = io.StringIO()
+    out.write(HEADER)
+    out.write("# Single-file installer: kubectl apply -f deploy/v2beta1/tpu-operator.yaml\n")
+    docs = [namespace] + list(yaml.safe_load_all(crd_text)) + [config]
+    for name in (
+        "service-account.yaml",
+        "cluster-role.yaml",
+        "cluster-role-binding.yaml",
+        "deployment.yaml",
+    ):
+        for doc in yaml.safe_load_all((base / name).read_text()):
+            if doc:
+                docs.append(doc)
+    for doc in docs:
+        # The flat file is namespaced explicitly (kustomize would do this).
+        if doc["kind"] in ("ServiceAccount", "Deployment"):
+            doc["metadata"]["namespace"] = "tpu-operator"
+        if doc["kind"] == "ClusterRoleBinding":
+            for subj in doc.get("subjects", []):
+                subj["namespace"] = "tpu-operator"
+        out.write("---\n")
+        out.write(dump(doc))
+    return out.getvalue()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--verify", action="store_true",
+                        help="fail if checked-in files differ from generated")
+    args = parser.parse_args()
+
+    crd_text = HEADER + dump(build_crd())
+    targets = {
+        ROOT / "crd" / "kubeflow.org_tpujobs.yaml": crd_text,
+        ROOT / "manifests" / "base" / "crd.yaml": crd_text,
+        ROOT / "hack" / "helm" / "tpu-operator" / "crds" / "kubeflow.org_tpujobs.yaml": crd_text,
+    }
+    flat = flat_installer(ROOT / "manifests" / "base", crd_text)
+    targets[ROOT / "deploy" / "v2beta1" / "tpu-operator.yaml"] = flat
+
+    stale = []
+    for path, text in targets.items():
+        if args.verify:
+            if not path.exists() or path.read_text() != text:
+                stale.append(str(path.relative_to(ROOT)))
+        else:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(text)
+            print(f"wrote {path.relative_to(ROOT)}")
+    if stale:
+        print(f"stale generated manifests: {stale}; run hack/gen_manifests.py")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

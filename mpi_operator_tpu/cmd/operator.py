@@ -31,7 +31,7 @@ from .. import __version__
 from ..api.v2beta1 import constants
 from ..controller import status as st
 from ..controller.tpu_job_controller import TPUJobController
-from ..runtime.apiserver import RESOURCES, InMemoryAPIServer
+from ..runtime.apiserver import InMemoryAPIServer, NotFoundError
 from ..runtime.leaderelection import LeaderElectionConfig, LeaderElector
 from ..runtime.podrunner import LocalPodRunner
 from ..utils import metrics
@@ -51,6 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--threadiness", type=int, default=2, help="worker goroutine count")
     p.add_argument("--monitoring-port", type=int, default=0,
                    help="port for /metrics + /healthz (0 = disabled)")
+    p.add_argument("--monitoring-address", default="127.0.0.1",
+                   help="bind address for monitoring (0.0.0.0 in-cluster so "
+                        "kubelet probes can reach /healthz)")
     p.add_argument("--gang-scheduling", default="",
                    help="gang scheduler name (e.g. volcano); empty disables")
     p.add_argument("--leader-elect", action="store_true",
@@ -94,23 +97,26 @@ class _MonitoringHandler(BaseHTTPRequestHandler):
         pass
 
 
-def start_monitoring(port: int, registry: metrics.Registry, health_fn):
+def start_monitoring(port: int, registry: metrics.Registry, health_fn,
+                     address: str = "127.0.0.1"):
     """startMonitoring (main.go:29-40) + healthz server (:192-208) analog."""
     handler = type(
         "Handler",
         (_MonitoringHandler,),
         {"registry": registry, "health_fn": staticmethod(health_fn)},
     )
-    server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    server = ThreadingHTTPServer((address, port), handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server
 
 
-def check_crd_exists() -> None:
-    """CRD preflight (server.go:287-299 analog): fail fast if the TPUJob
-    resource is not served."""
-    if "tpujobs" not in RESOURCES:
+def check_crd_exists(api: InMemoryAPIServer) -> None:
+    """CRD preflight (server.go:287-299 analog): fail fast if the backend
+    does not serve the TPUJob resource."""
+    try:
+        api.list("tpujobs")
+    except NotFoundError:
         print(
             "CRD tpujobs.kubeflow.org not served; install the CRD first",
             file=sys.stderr,
@@ -120,15 +126,18 @@ def check_crd_exists() -> None:
 
 def run(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    check_crd_exists()
 
     api = InMemoryAPIServer()
+    check_crd_exists(api)
     registry = metrics.Registry()
     is_leader = metrics.new_gauge(
         "tpu_operator_is_leader", "1 if this replica is the leader", (), registry
     )
     controller = TPUJobController(
-        api, gang_scheduler_name=args.gang_scheduling, registry=registry
+        api,
+        namespace=args.namespace,
+        gang_scheduler_name=args.gang_scheduling,
+        registry=registry,
     )
     # Controller metrics share the exposed registry.
     runner = LocalPodRunner(api)
@@ -142,9 +151,18 @@ def run(argv=None) -> int:
             for doc in yaml.safe_load_all(f):
                 if not doc:
                     continue
-                doc.setdefault("metadata", {}).setdefault(
-                    "namespace", args.namespace or "default"
-                )
+                meta = doc.setdefault("metadata", {})
+                meta.setdefault("namespace", args.namespace or "default")
+                if args.namespace and meta["namespace"] != args.namespace:
+                    # A scoped controller would never reconcile it and
+                    # --exit-on-completion would hang; refuse loudly.
+                    print(
+                        f"error: {path}: TPUJob namespace "
+                        f"{meta['namespace']!r} is outside the watched "
+                        f"namespace {args.namespace!r}",
+                        file=sys.stderr,
+                    )
+                    return 1
                 created = api.create("tpujobs", doc)
                 applied.append(
                     (created["metadata"]["namespace"], created["metadata"]["name"])
@@ -154,17 +172,10 @@ def run(argv=None) -> int:
     stop = threading.Event()
 
     def lead(lost: threading.Event) -> None:
+        # LeaderElector.run sets `lost` both on renew failure and when the
+        # process-level stop fires, so it doubles as the term's stop event.
         is_leader.set(1)
-        local_stop = threading.Event()
-
-        def forward():
-            # stop when either leadership is lost or the process stops
-            while not (lost.is_set() or stop.is_set()):
-                time.sleep(0.05)
-            local_stop.set()
-
-        threading.Thread(target=forward, daemon=True).start()
-        controller.run(threadiness=args.threadiness, stop=local_stop)
+        controller.run(threadiness=args.threadiness, stop=lost)
 
     threads = []
     elector = None
@@ -191,8 +202,13 @@ def run(argv=None) -> int:
     # against a half-initialized process.
     if args.monitoring_port:
         health = elector.healthy if elector is not None else (lambda: True)
-        start_monitoring(args.monitoring_port, registry, health)
-        print(f"monitoring on http://127.0.0.1:{args.monitoring_port}/metrics")
+        start_monitoring(
+            args.monitoring_port, registry, health, address=args.monitoring_address
+        )
+        print(
+            f"monitoring on http://{args.monitoring_address}:"
+            f"{args.monitoring_port}/metrics"
+        )
 
     for t in threads:
         t.start()
@@ -202,7 +218,14 @@ def run(argv=None) -> int:
             if args.exit_on_completion and applied:
                 finals = []
                 for ns, name in applied:
-                    job = api.get("tpujobs", ns, name)
+                    try:
+                        job = api.get("tpujobs", ns, name)
+                    except NotFoundError:
+                        # Deleted out from under us: terminal, counts as failed.
+                        finals.append(
+                            (ns, name, {"type": "Failed", "reason": "Deleted"})
+                        )
+                        continue
                     terminal = [
                         c
                         for c in (job.get("status") or {}).get("conditions") or []

@@ -100,6 +100,7 @@ class TPUJobController:
         self,
         api: InMemoryAPIServer,
         *,
+        namespace: str = "",
         gang_scheduler_name: str = "",
         recorder: Optional[EventRecorder] = None,
         registry: Optional[metrics.Registry] = None,
@@ -131,7 +132,9 @@ class TPUJobController:
             registry,
         )
 
-        self.factory = InformerFactory(api)
+        # Namespace-scoped or cluster-wide informers (server.go:139-147
+        # analog): "" watches all namespaces.
+        self.factory = InformerFactory(api, namespace=namespace)
         self.tpujob_informer = self.factory.informer("tpujobs")
         self.pod_informer = self.factory.informer("pods")
         self.service_informer = self.factory.informer("services")
@@ -240,7 +243,9 @@ class TPUJobController:
 
         threads = [threading.Thread(target=pump_loop, daemon=True)]
         for _ in range(threadiness):
-            threads.append(threading.Thread(target=self._worker_loop, daemon=True))
+            threads.append(
+                threading.Thread(target=self._worker_loop, args=(stop,), daemon=True)
+            )
         for t in threads:
             t.start()
         stop.wait()
@@ -249,8 +254,11 @@ class TPUJobController:
             t.join(timeout=5)
         self.factory.stop_all()
 
-    def _worker_loop(self) -> None:
-        while self.process_next_work_item():
+    def _worker_loop(self, stop: threading.Event) -> None:
+        # The stop check makes a worker that outlived its term's join timeout
+        # (stuck in a long sync_handler) exit after that item instead of
+        # consuming from the re-armed queue alongside the next term's workers.
+        while not stop.is_set() and self.process_next_work_item():
             pass
 
     def process_next_work_item(self) -> bool:

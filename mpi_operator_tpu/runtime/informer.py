@@ -64,9 +64,10 @@ class Lister:
 
 
 class Informer:
-    def __init__(self, api: InMemoryAPIServer, resource: str):
+    def __init__(self, api: InMemoryAPIServer, resource: str, namespace: str = ""):
         self._api = api
         self.resource = resource
+        self.namespace = namespace  # "" = cluster-wide (server.go:139-147 analog)
         self._lock = threading.RLock()
         self._cache: dict[str, dict] = {}
         self._handlers: list[EventHandler] = []
@@ -108,21 +109,40 @@ class Informer:
     def add_event_handler(self, handler: EventHandler) -> None:
         self._handlers.append(handler)
 
+    def _in_scope(self, obj: dict) -> bool:
+        return not self.namespace or (obj.get("metadata") or {}).get(
+            "namespace", ""
+        ) == self.namespace
+
     def start(self) -> None:
         """Open the watch, then load the initial listing into the cache.
 
         Opening the watch first guarantees no lost updates: anything that
         changes between list and first pump arrives as a watch event.
+        Re-entrant (leadership regained after a step-down): the fresh list
+        *replaces* the previous term's cache, and objects that disappeared
+        while we were not watching fire on_delete instead of lingering as
+        ghosts.
         """
         with self._lock:
             if self._watch is not None:
                 return
             self._watch = self._api.watch(self.resource)
-            for obj in self._api.list(self.resource):
-                key = meta_namespace_key(obj)
-                self._cache[key] = obj
+            fresh = {
+                meta_namespace_key(obj): obj
+                for obj in self._api.list(self.resource)
+                if self._in_scope(obj)
+            }
+            removed = [
+                obj for key, obj in self._cache.items() if key not in fresh
+            ]
+            self._cache = fresh
             self._synced = True
-        # Initial adds fire outside the lock.
+        # Handlers fire outside the lock.
+        for obj in removed:
+            for h in self._handlers:
+                if h.on_delete:
+                    h.on_delete(_deep_copy(obj))
         for obj in self.cache_list():
             for h in self._handlers:
                 if h.on_add:
@@ -139,10 +159,16 @@ class Informer:
         the initial list (same resourceVersion) collapse into no-op updates,
         which handlers still see — the workqueue dedups, as in client-go.
         """
-        if self._watch is None:
-            raise RuntimeError(f"informer for {self.resource} not started")
-        events = self._watch.drain()
+        # Snapshot under the lock: stop() may null the watch concurrently
+        # (the pump loop is not joined before stop_all at step-down).
+        with self._lock:
+            watch = self._watch
+        if watch is None:
+            return 0
+        events = watch.drain()
         for event in events:
+            if not self._in_scope(event.object):
+                continue
             key = meta_namespace_key(event.object)
             with self._lock:
                 old = self._cache.get(key)
@@ -179,13 +205,16 @@ class InformerFactory:
     informers.NewSharedInformerFactory in app/server.go:139-147.
     """
 
-    def __init__(self, api: InMemoryAPIServer):
+    def __init__(self, api: InMemoryAPIServer, namespace: str = ""):
         self._api = api
+        self.namespace = namespace
         self._informers: dict[str, Informer] = {}
 
     def informer(self, resource: str) -> Informer:
         if resource not in self._informers:
-            self._informers[resource] = Informer(self._api, resource)
+            self._informers[resource] = Informer(
+                self._api, resource, namespace=self.namespace
+            )
         return self._informers[resource]
 
     def start_all(self) -> None:

@@ -1,0 +1,232 @@
+"""Deployment-packaging tests (SURVEY.md §2 inventory #15-16).
+
+Covers the controller-gen/`make crd` analog (hack/gen_manifests.py), the
+kustomize tree, the flat installer, and the helm chart — including checking
+the example TPUJob YAMLs against the generated CRD's structural schema
+(reference analog: apiserver-side CRD validation,
+v2/crd/kubeflow.org_mpijobs.yaml).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def load_all(path: pathlib.Path) -> list[dict]:
+    return [d for d in yaml.safe_load_all(path.read_text()) if d]
+
+
+def yaml_files(*dirs: str) -> list[pathlib.Path]:
+    out: list[pathlib.Path] = []
+    for d in dirs:
+        out.extend(sorted((ROOT / d).rglob("*.yaml")))
+    return [p for p in out if p.suffix == ".yaml" and "helm" not in p.parts]
+
+
+def test_all_manifest_yaml_parses():
+    files = yaml_files("manifests", "deploy", "crd", "examples")
+    assert files, "no manifest files found"
+    for f in files:
+        assert load_all(f), f"{f} is empty or unparseable"
+
+
+def test_generated_manifests_are_fresh():
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "hack" / "gen_manifests.py"), "--verify"],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def crd_doc() -> dict:
+    (doc,) = load_all(ROOT / "crd" / "kubeflow.org_tpujobs.yaml")
+    return doc
+
+
+def test_crd_shape():
+    crd = crd_doc()
+    assert crd["kind"] == "CustomResourceDefinition"
+    assert crd["metadata"]["name"] == "tpujobs.kubeflow.org"
+    spec = crd["spec"]
+    assert spec["group"] == "kubeflow.org"
+    assert spec["names"]["kind"] == "TPUJob"
+    (ver,) = spec["versions"]
+    assert ver["name"] == "v2beta1" and ver["served"] and ver["storage"]
+    assert ver["subresources"] == {"status": {}}
+    schema = ver["schema"]["openAPIV3Schema"]
+    job_spec = schema["properties"]["spec"]
+    assert job_spec["required"] == ["tpuReplicaSpecs"]
+    assert job_spec["properties"]["tpuReplicaSpecs"]["required"] == ["Worker"]
+    # No SSH, no MPI knobs anywhere in the TPU-native schema.
+    text = yaml.safe_dump(crd)
+    for banned in ("ssh", "mpiImplementation", "slotsPerWorker", "nvidia"):
+        assert banned not in text, f"reference-ism {banned!r} leaked into CRD"
+
+
+# -- minimal structural-schema validator (the subset gen_manifests emits) --
+
+
+def validate(obj, schema, path="$") -> list[str]:
+    errs: list[str] = []
+    t = schema.get("type")
+    if schema.get("x-kubernetes-preserve-unknown-fields"):
+        return errs
+    if t == "object":
+        if not isinstance(obj, dict):
+            return [f"{path}: expected object, got {type(obj).__name__}"]
+        props = schema.get("properties", {})
+        for req in schema.get("required", []):
+            if req not in obj:
+                errs.append(f"{path}: missing required field {req!r}")
+        addl = schema.get("additionalProperties")
+        for k, v in obj.items():
+            if k in props:
+                errs += validate(v, props[k], f"{path}.{k}")
+            elif isinstance(addl, dict):
+                errs += validate(v, addl, f"{path}.{k}")
+            elif props:
+                errs.append(f"{path}: unknown field {k!r}")
+    elif t == "array":
+        if not isinstance(obj, list):
+            return [f"{path}: expected array"]
+        for i, item in enumerate(obj):
+            errs += validate(item, schema["items"], f"{path}[{i}]")
+    elif t == "integer":
+        if not isinstance(obj, int) or isinstance(obj, bool):
+            return [f"{path}: expected integer"]
+        if "minimum" in schema and obj < schema["minimum"]:
+            errs.append(f"{path}: {obj} < minimum {schema['minimum']}")
+        if "maximum" in schema and obj > schema["maximum"]:
+            errs.append(f"{path}: {obj} > maximum {schema['maximum']}")
+    elif t == "number":
+        if not isinstance(obj, (int, float)) or isinstance(obj, bool):
+            return [f"{path}: expected number"]
+    elif t == "boolean":
+        if not isinstance(obj, bool):
+            return [f"{path}: expected boolean"]
+    elif t == "string":
+        if not isinstance(obj, str):
+            return [f"{path}: expected string"]
+        if "enum" in schema and obj not in schema["enum"]:
+            errs.append(f"{path}: {obj!r} not in {schema['enum']}")
+        if "pattern" in schema:
+            import re
+
+            if not re.search(schema["pattern"], obj):
+                errs.append(f"{path}: {obj!r} !~ {schema['pattern']}")
+    return errs
+
+
+def example_files() -> list[pathlib.Path]:
+    return [
+        p
+        for p in yaml_files("examples")
+        if any(d.get("kind") == "TPUJob" for d in load_all(p))
+    ]
+
+
+@pytest.mark.parametrize("path", example_files(), ids=lambda p: p.stem)
+def test_examples_validate_against_crd_schema(path):
+    schema = crd_doc()["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    for doc in load_all(path):
+        if doc.get("kind") != "TPUJob":
+            continue
+        errs = validate(doc, schema)
+        assert not errs, f"{path}: {errs}"
+
+
+def test_crd_schema_rejects_bad_specs():
+    schema = crd_doc()["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    bad = {
+        "apiVersion": "kubeflow.org/v2beta1",
+        "kind": "TPUJob",
+        "metadata": {"name": "x"},
+        "spec": {
+            "tpu": {"acceleratorType": "h100-8", "numSlices": 0},
+            "runPolicy": {"cleanPodPolicy": "Sometimes"},
+            "tpuReplicaSpecs": {
+                "Worker": {"restartPolicy": "Always", "template": {}}
+            },
+        },
+    }
+    errs = validate(bad, schema)
+    joined = "\n".join(errs)
+    assert "acceleratorType" in joined
+    assert "numSlices" in joined
+    assert "cleanPodPolicy" in joined
+    assert "restartPolicy" in joined
+
+
+def test_flat_installer_is_complete():
+    docs = load_all(ROOT / "deploy" / "v2beta1" / "tpu-operator.yaml")
+    kinds = [d["kind"] for d in docs]
+    for kind in (
+        "Namespace",
+        "CustomResourceDefinition",
+        "ConfigMap",
+        "ServiceAccount",
+        "ClusterRole",
+        "ClusterRoleBinding",
+        "Deployment",
+    ):
+        assert kind in kinds, f"flat installer missing {kind}"
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    assert dep["metadata"]["namespace"] == "tpu-operator"
+    crb = next(d for d in docs if d["kind"] == "ClusterRoleBinding")
+    assert crb["subjects"][0]["namespace"] == "tpu-operator"
+    # Every configMapKeyRef in the deployment resolves within the flat file.
+    cm = next(d for d in docs if d["kind"] == "ConfigMap")
+    for c in dep["spec"]["template"]["spec"]["containers"]:
+        for env in c.get("env", []):
+            ref = (env.get("valueFrom") or {}).get("configMapKeyRef")
+            if ref:
+                assert ref["name"] == cm["metadata"]["name"]
+                assert ref["key"] in cm["data"]
+
+
+def test_kustomize_base_lists_existing_resources():
+    base = ROOT / "manifests" / "base"
+    (kust,) = load_all(base / "kustomization.yaml")
+    for res in kust["resources"]:
+        assert (base / res).exists(), f"manifests/base/{res} missing"
+    assert "crd.yaml" in kust["resources"]
+    for overlay in ("standalone", "kubeflow"):
+        odir = ROOT / "manifests" / "overlays" / overlay
+        (okust,) = load_all(odir / "kustomization.yaml")
+        assert "../../base" in okust["resources"]
+
+
+def test_rbac_has_no_secret_access():
+    """TPU-native design point: no per-job SSH Secret => no secrets RBAC."""
+    (role,) = load_all(ROOT / "manifests" / "base" / "cluster-role.yaml")
+    for rule in role["rules"]:
+        assert "secrets" not in rule.get("resources", [])
+
+
+def test_helm_chart_structure():
+    chart = ROOT / "hack" / "helm" / "tpu-operator"
+    (meta,) = load_all(chart / "Chart.yaml")
+    assert meta["name"] == "tpu-operator"
+    values = yaml.safe_load((chart / "values.yaml").read_text())
+    assert values["image"]["repository"] == "tpuoperator/tpu-operator"
+    crds = load_all(chart / "crds" / "kubeflow.org_tpujobs.yaml")
+    assert crds[0]["kind"] == "CustomResourceDefinition"
+    templates = {p.name for p in (chart / "templates").iterdir()}
+    assert {
+        "tpu-operator-deployment.yaml",
+        "tpu-operator-clusterrole.yaml",
+        "tpu-operator-rolebinding.yaml",
+        "tpu-operator-serviceaccount.yaml",
+        "_helpers.tpl",
+    } <= templates
+    # The CRD ships in crds/ ONLY — a templated copy would make helm
+    # conflict with its own crds/ install.
+    assert "tpujob-crd.yaml" not in templates

@@ -270,3 +270,45 @@ class TestQueueReset:
         q.reset()
         q.add("a")
         assert q.get(timeout=1) == ("a", False)
+
+
+class TestInformerRestart:
+    def test_restart_reconciles_deletions_missed_while_stopped(self):
+        """Objects deleted while the informer was stopped (a non-leading
+        replica) must not survive as ghosts in the cache after restart."""
+        api = InMemoryAPIServer()
+        api.create("pods", pod("keep"))
+        api.create("pods", pod("ghost"))
+        factory = InformerFactory(api)
+        informer = factory.informer("pods")
+        deletes = []
+        informer.add_event_handler(
+            EventHandler(on_delete=lambda o: deletes.append(o["metadata"]["name"]))
+        )
+        factory.start_all()
+        factory.pump_until_quiet()
+        factory.stop_all()
+
+        api.delete("pods", "default", "ghost")  # while not watching
+
+        factory.start_all()
+        names = [p["metadata"]["name"] for p in informer.lister.list()]
+        assert names == ["keep"]
+        assert deletes == ["ghost"]
+
+    def test_namespace_scoped_informer_filters(self):
+        api = InMemoryAPIServer()
+        api.create("pods", pod("a", ns="team-a"))
+        api.create("pods", pod("b", ns="team-b"))
+        factory = InformerFactory(api, namespace="team-a")
+        informer = factory.informer("pods")
+        adds = []
+        informer.add_event_handler(
+            EventHandler(on_add=lambda o: adds.append(o["metadata"]["name"]))
+        )
+        factory.start_all()
+        api.create("pods", pod("c", ns="team-b"))
+        api.create("pods", pod("d", ns="team-a"))
+        factory.pump_until_quiet()
+        assert adds == ["a", "d"]
+        assert [p["metadata"]["name"] for p in informer.lister.list()] == ["a", "d"]
