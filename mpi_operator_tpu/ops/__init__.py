@@ -1,0 +1,17 @@
+"""TPU compute ops: pallas kernels and sequence-parallel collectives.
+
+The reference operator contains no kernels (it orchestrates user MPI
+programs); this layer is where our framework's *workload* half earns the
+"TPU-native" name: flash attention on the MXU via pallas, and ring
+attention over an ``sp`` mesh axis for long-context training.
+"""
+
+from .attention import attention_reference, flash_attention
+from .ring_attention import ring_attention, ring_attention_sharded
+
+__all__ = [
+    "attention_reference",
+    "flash_attention",
+    "ring_attention",
+    "ring_attention_sharded",
+]

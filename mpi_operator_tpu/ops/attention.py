@@ -1,0 +1,394 @@
+"""Flash attention as a pallas TPU kernel (forward + backward).
+
+The reference delegates all math to user containers (its only compute is
+the MPI pi example, /root/reference/examples/v2beta1/pi/pi.cc); our
+framework ships the attention hot op itself, TPU-first:
+
+- streaming online-softmax forward — O(seq) memory, never materialises
+  the [Sq, Sk] score matrix in HBM;
+- s = q @ k^T and p @ v ride the MXU (f32 accumulation via
+  ``preferred_element_type``), masks/exponentials ride the VPU;
+- flash-attention-2 style backward as two pallas kernels (dq; dk+dv)
+  recomputing p from the saved logsumexp;
+- grid iterates k-blocks innermost so accumulators live in VMEM scratch
+  across the contraction.
+
+Off-TPU (tests run on a virtual CPU mesh, conftest.py) the same kernels
+execute in pallas interpret mode, so numerics are validated everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # safe "minus infinity": avoids inf-inf → nan in masking
+
+
+def attention_reference(
+    q, k, v, *, causal: bool = False, sm_scale: Optional[float] = None
+):
+    """Plain XLA attention (f32 softmax) — the oracle for kernel tests and
+    the fallback for shapes the kernel does not support.
+
+    Shapes: q [B, H, Sq, D]; k, v [B, H, Sk, D].
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    if causal:
+        sq, sk = q.shape[-2], k.shape[-2]
+        row = jnp.arange(sq)[:, None] + (sk - sq)  # align last q row to last k row
+        col = jnp.arange(sk)[None, :]
+        s = jnp.where(col <= row, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(p.dtype)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref,  # inputs
+    o_ref, lse_ref,  # outputs
+    acc_ref, m_ref, l_ref,  # VMEM scratch, carried across the k grid axis
+    *, sm_scale, causal, q_len, kv_len, block_q, block_k,
+):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = (row < q_len) & (col < kv_len)
+    if causal:
+        mask &= col <= row + (kv_len - q_len)
+
+    # With causal masking, blocks strictly above the diagonal contribute
+    # nothing — skip their FLOPs (the grid still visits them; the MXU does
+    # not).
+    def compute():
+        s = jax.lax.dot_general(
+            q_ref[0],
+            k_ref[0],
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        s = s * sm_scale
+        m_prev, l_prev = m_ref[:, :1], l_ref[:, :1]
+        m_cur = jnp.max(jnp.where(mask, s, NEG_INF), axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(jnp.where(mask, s - m_new, NEG_INF))
+        correction = jnp.exp(m_prev - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            correction * l_prev + jnp.sum(p, axis=1, keepdims=True), l_ref.shape
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[:] = acc_ref[:] * correction + jax.lax.dot(
+            p.astype(v_ref.dtype), v_ref[0], preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        # Lowest global column of this block vs highest visible column of
+        # this q block: block is live iff some (row, col) passes the mask.
+        live = j * block_k <= i * block_q + (block_q - 1) + (kv_len - q_len)
+        pl.when(live)(compute)
+    else:
+        compute()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse = jnp.where(l > 0.0, m_ref[:, :1] + jnp.log(safe_l), NEG_INF)
+        lse_ref[0] = lse[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (flash-attention-2: recompute p from saved lse)
+# ---------------------------------------------------------------------------
+
+
+def _masked_p(q, k, lse_col, mask, sm_scale):
+    """Recompute p = exp(q k^T * scale - lse) with masking folded into the
+    exponent (so fully-masked/padded rows give exactly 0, never inf)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return jnp.exp(jnp.where(mask, s * sm_scale - lse_col, NEG_INF))
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref,
+    dq_acc_ref,
+    *, sm_scale, causal, q_len, kv_len, block_q, block_k,
+):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = (row < q_len) & (col < kv_len)
+    if causal:
+        mask &= col <= row + (kv_len - q_len)
+
+    def compute():
+        p = _masked_p(q_ref[0], k_ref[0], lse_ref[0].reshape(block_q, 1), mask, sm_scale)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0].reshape(block_q, 1))
+        dq_acc_ref[:] += sm_scale * jax.lax.dot(
+            ds.astype(k_ref.dtype), k_ref[0], preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        live = j * block_k <= i * block_q + (block_q - 1) + (kv_len - q_len)
+        pl.when(live)(compute)
+    else:
+        compute()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref,
+    dk_acc_ref, dv_acc_ref,
+    *, sm_scale, causal, q_len, kv_len, block_q, block_k,
+):
+    # Grid: (batch*heads, k-blocks, q-blocks) — q innermost so dk/dv
+    # accumulate in VMEM across the q contraction.
+    j, i = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = (row < q_len) & (col < kv_len)
+    if causal:
+        mask &= col <= row + (kv_len - q_len)
+
+    def compute():
+        p = _masked_p(q_ref[0], k_ref[0], lse_ref[0].reshape(block_q, 1), mask, sm_scale)
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0].reshape(block_q, 1))
+        dk_acc_ref[:] += sm_scale * jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        live = j * block_k <= i * block_q + (block_q - 1) + (kv_len - q_len)
+        pl.when(live)(compute)
+    else:
+        compute()
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Host-side wrappers
+# ---------------------------------------------------------------------------
+
+
+def _pad_to(x, axis: int, multiple: int):
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    bh, q_len, d = q.shape
+    kv_len = k.shape[1]
+    qp = _pad_to(q, 1, block_q)
+    kp = _pad_to(k, 1, block_k)
+    vp = _pad_to(v, 1, block_k)
+    nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        sm_scale=sm_scale, causal=causal,
+        q_len=q_len, kv_len=kv_len, block_q=block_q, block_k=block_k,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qp.shape, q.dtype),
+            jax.ShapeDtypeStruct(qp.shape[:2], jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :q_len], lse[:, :q_len]
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    bh, q_len, d = q.shape
+    kv_len = k.shape[1]
+    # delta_i = rowsum(do_i * o_i): tiny elementwise reduce — let XLA fuse it.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    qp = _pad_to(q, 1, block_q)
+    kp = _pad_to(k, 1, block_k)
+    vp = _pad_to(v, 1, block_k)
+    dop = _pad_to(do, 1, block_q)
+    lsep = _pad_to(lse, 1, block_q)
+    deltap = _pad_to(delta, 1, block_q)
+    nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
+
+    common = dict(
+        sm_scale=sm_scale, causal=causal,
+        q_len=q_len, kv_len=kv_len, block_q=block_q, block_k=block_k,
+    )
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(kp.shape, k.dtype),
+            jax.ShapeDtypeStruct(vp.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    return dq[:, :q_len], dk[:, :kv_len], dv[:, :kv_len]
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q, k, v,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Flash attention. q [B, H, Sq, D]; k, v [B, H, Sk, D] → [B, H, Sq, D].
+
+    Differentiable (custom VJP, both passes pallas). On non-TPU backends
+    the kernels run in interpret mode so the same code path is testable
+    on the virtual CPU mesh.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected [B, H, S, D] inputs, got rank {q.ndim}")
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+    b, h, q_len, d = q.shape
+    kv_len = k.shape[2]
+    block_q = min(block_q, max(q_len, 1))
+    block_k = min(block_k, max(kv_len, 1))
+    flat = lambda x: x.reshape(b * h, x.shape[2], d)
+    out = _flash(
+        flat(q), flat(k), flat(v), sm_scale, causal, block_q, block_k, interpret
+    )
+    return out.reshape(b, h, q_len, d)

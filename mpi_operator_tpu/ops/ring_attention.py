@@ -1,0 +1,140 @@
+"""Ring attention: sequence/context parallelism over an ``sp`` mesh axis.
+
+Long-context training shards the *sequence* dimension across devices; no
+single chip ever holds full-length k/v. Each device keeps its local q
+shard and streams k/v shards around the ring with ``lax.ppermute``
+(nearest-neighbor ICI hops — the cheapest collective on a TPU torus),
+merging each partial attention with an online-softmax update. Compute on
+step t overlaps the permute for step t+1 under XLA's async collectives.
+
+This is the piece of the stack the reference has no analog for: its
+operator hands out ranks and the user's MPI program owns the math
+(SURVEY.md §2.4 — TP/SP/ring-attention "absent, delegated to user
+programs"). Here the framework owns it.
+
+Differentiable end-to-end: the ring is a ``lax.scan`` of pure jnp ops
+plus ``ppermute`` (which has a transpose rule), so reverse-mode autodiff
+replays the ring backwards without custom VJP code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import DP, FSDP, SP
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q, k, v,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+):
+    """Per-shard ring attention — call inside shard_map/pmap.
+
+    q, k, v: local shards [B, H, S_local, D]; the global sequence is the
+    concatenation over ``axis_name`` (device i holds rows
+    [i*S_local, (i+1)*S_local)). Returns the local output shard.
+
+    Causal note: plain ring order leaves later-ranked devices doing more
+    unmasked work than earlier ones (a known imbalance; zigzag ordering
+    halves it). Masked-out steps still circulate k/v but contribute no
+    matmul results.
+    """
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+
+    b, h, s_loc, d = q.shape
+    qf = q.astype(jnp.float32)
+    row = my * s_loc + jnp.arange(s_loc)  # global row ids of the local q shard
+
+    def step(carry, t):
+        acc, m, l, k_cur, v_cur = carry
+        # k_cur originated on device (my - t) mod n.
+        src = jax.lax.rem(my - t + n, n)
+        col = src * s_loc + jnp.arange(s_loc)  # global col ids of k_cur
+
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if causal:
+            mask = col[None, None, None, :] <= row[None, None, :, None]
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * correction + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (acc_new, m_new, l_new, k_nxt, v_nxt), None
+
+    # Inits derived from qf so they carry the same varying-axes type as the
+    # loop outputs under shard_map's vma checking.
+    init = (
+        jnp.zeros_like(qf),
+        jnp.full_like(qf[..., :1], NEG_INF),
+        jnp.zeros_like(qf[..., :1]),
+        k,
+        v,
+    )
+    (acc, _, l, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
+    out = acc / jnp.where(l > 0.0, l, 1.0)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q, k, v,
+    mesh,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    axis: str = SP,
+):
+    """Global-view ring attention: shard_map the per-shard kernel over the
+    mesh, batch over dp×fsdp and sequence over ``axis``.
+
+    Inputs are global [B, H, S, D] arrays (S divisible by the sp axis
+    size); sharding constraints place them before the shard_map so XLA
+    does not gather the sequence axis.
+    """
+    if axis not in mesh.axis_names:
+        return None  # caller should fall back to dense attention
+    from jax import shard_map
+
+    batch_axes = tuple(a for a in (DP, FSDP) if a in mesh.axis_names)
+    spec = P(batch_axes if batch_axes else None, None, axis, None)
+
+    @jax.jit
+    def run(q, k, v):
+        q_, k_, v_ = (jax.lax.with_sharding_constraint(x, spec) for x in (q, k, v))
+        fn = shard_map(
+            lambda a, b_, c: ring_attention(
+                a, b_, c, axis, causal=causal, sm_scale=sm_scale
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+        return fn(q_, k_, v_)
+
+    with mesh:
+        return run(q, k, v)

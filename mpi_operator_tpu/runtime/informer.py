@@ -164,7 +164,11 @@ class Informer:
         with self._lock:
             watch = self._watch
         if watch is None:
-            return 0
+            if not self._synced:
+                raise RuntimeError(
+                    f"informer for {self.kind} not started; call start() first"
+                )
+            return 0  # started, then stopped: clean shutdown
         events = watch.drain()
         for event in events:
             if not self._in_scope(event.object):

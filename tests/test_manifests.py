@@ -27,7 +27,7 @@ def yaml_files(*dirs: str) -> list[pathlib.Path]:
     out: list[pathlib.Path] = []
     for d in dirs:
         out.extend(sorted((ROOT / d).rglob("*.yaml")))
-    return [p for p in out if p.suffix == ".yaml" and "helm" not in p.parts]
+    return out
 
 
 def test_all_manifest_yaml_parses():
