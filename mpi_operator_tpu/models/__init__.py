@@ -1,4 +1,15 @@
-"""JAX/Flax example workloads — the TPU-native replacements for the
-reference's Horovod/tf_cnn_benchmarks example images (reference analog:
-/root/reference/examples/v2beta1/tensorflow-benchmarks/,
-horovod examples, pi.cc)."""
+"""JAX/Flax model library — the TPU-native replacement for the
+reference's user-container workloads (reference analog:
+/root/reference/examples/v2beta1/tensorflow-benchmarks/, horovod
+examples, pi.cc).
+
+- ``resnet``: ResNet v1.5 (the headline benchmark family, BASELINE.md).
+- ``bert``: BERT-base encoder, MLM pretraining (milestone config 3).
+- ``llama``: Llama-family decoder with FSDP/TP/SP shardings and
+  flash/ring attention (milestone config 4).
+"""
+
+# No eager submodule imports: consumers import the single model family
+# they need (bench.py / __graft_entry__ pull resnet only, inside
+# functions) without paying for flax/optax/pallas of the others.
+__all__ = ["bert", "llama", "resnet"]

@@ -1,0 +1,156 @@
+"""BERT-family encoder in Flax — the multi-host milestone workload.
+
+BASELINE.md milestone config 3 ("Flax BERT-base on v5e-16 multi-host").
+Masked-language-model pretraining objective; bidirectional attention
+through the pallas flash kernel (no causal mask); bfloat16 compute with
+float32 params. Sharding: dp/fsdp over batch and params via the generic
+``parallel.shard_params`` heuristic, plus tp rules for the dense kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import attention_reference, flash_attention
+from ..parallel.mesh import FSDP, TP
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "flash"  # or 'dense'
+
+
+def bert_base(**overrides) -> BertConfig:
+    return dataclasses.replace(BertConfig(), **overrides)
+
+
+def tiny(**overrides) -> BertConfig:
+    base = BertConfig(
+        vocab_size=128, dim=32, n_layers=2, n_heads=2, ffn_dim=64,
+        max_seq_len=64, dtype=jnp.float32, attention_impl="dense",
+    )
+    return dataclasses.replace(base, **overrides)
+
+
+class EncoderLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, s, _ = x.shape
+        hd = cfg.dim // cfg.n_heads
+        dense = lambda feats, name: nn.Dense(
+            feats, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
+        )
+
+        q = dense(cfg.dim, "wq")(x).reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        k = dense(cfg.dim, "wk")(x).reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        v = dense(cfg.dim, "wv")(x).reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        if cfg.attention_impl == "flash":
+            att = flash_attention(q, k, v)
+        else:
+            att = attention_reference(q, k, v)
+        att = att.transpose(0, 2, 1, 3).reshape(b, s, cfg.dim)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="attn_norm")(
+            x + dense(cfg.dim, "wo")(att)
+        )
+        h = nn.gelu(dense(cfg.ffn_dim, "ffn_in")(x))
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="ffn_norm")(
+            x + dense(cfg.dim, "ffn_out")(h)
+        )
+        return x
+
+
+class Bert(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, token_types=None):
+        cfg = self.config
+        b, s = tokens.shape
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="tok_embed",
+        )
+        h = embed(tokens)
+        h = h + nn.Embed(
+            cfg.max_seq_len, cfg.dim, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="pos_embed",
+        )(jnp.broadcast_to(jnp.arange(s), (b, s)))
+        if token_types is not None:
+            h = h + nn.Embed(
+                cfg.type_vocab_size, cfg.dim, dtype=cfg.dtype,
+                param_dtype=jnp.float32, name="type_embed",
+            )(token_types)
+        h = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="embed_norm")(h)
+        for i in range(cfg.n_layers):
+            h = EncoderLayer(cfg, name=f"layer_{i}")(h)
+        # MLM head: transform + tied decoder, f32 logits.
+        h = nn.Dense(
+            cfg.dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="mlm_dense"
+        )(h)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="mlm_norm")(h)
+        return embed.attend(h.astype(jnp.float32))
+
+
+def init_params(model: Bert, rng, batch: int = 2, seq: int = 16):
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    return model.init(rng, tokens)["params"]
+
+
+def mlm_loss(model: Bert, params, tokens, mlm_positions_mask, mlm_targets):
+    """Masked-LM cross-entropy; ``mlm_positions_mask`` is 1.0 where the
+    token was masked out (loss counted), 0.0 elsewhere."""
+    logits = model.apply({"params": params}, tokens)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, mlm_targets)
+    weight = mlm_positions_mask.astype(jnp.float32)
+    return jnp.sum(ce * weight) / jnp.maximum(jnp.sum(weight), 1.0)
+
+
+def make_train_step(model: Bert, optimizer):
+    def train_step(params, opt_state, tokens, mask, targets):
+        loss, grads = jax.value_and_grad(
+            lambda p: mlm_loss(model, p, tokens, mask, targets)
+        )(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def param_sharding_rules(mesh):
+    """tp/fsdp rules for ``parallel.shard_params`` (see llama.py)."""
+    names = set(mesh.axis_names)
+    tp = TP if TP in names else None
+    fsdp = FSDP if FSDP in names else None
+
+    def ends_with(*suffixes):
+        return lambda path, leaf: any(path.endswith(s) for s in suffixes)
+
+    return [
+        (ends_with("wq/kernel", "wk/kernel", "wv/kernel", "ffn_in/kernel"),
+         P(fsdp, tp)),
+        (ends_with("wo/kernel", "ffn_out/kernel"), P(tp, fsdp)),
+        # Only the vocab-sized table is safe to split over tp; pos/type
+        # tables (512- and 2-row) stay on the fsdp heuristic.
+        (ends_with("tok_embed/embedding"), P(tp, fsdp)),
+    ]

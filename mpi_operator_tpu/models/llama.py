@@ -1,0 +1,246 @@
+"""Llama-family decoder transformer in Flax — the FSDP/TP/SP flagship.
+
+BASELINE.md milestone config 4 ("Flax Llama-3-8B FSDP on v5p-64"). The
+reference operator never touches model code (it schedules user Horovod
+containers, SURVEY.md §2.4); in our framework the model library is
+first-class and TPU-first:
+
+- bfloat16 compute / float32 params, f32 logits for the loss;
+- attention through the pallas flash kernel (``ops.flash_attention``) or
+  ring attention over an ``sp`` mesh axis (``ops.ring_attention``) for
+  long-context sequence parallelism;
+- GSPMD sharding rules (``param_sharding_rules``) lay qkv/mlp kernels out
+  over ``tp`` and everything large over ``fsdp``, so the train step's
+  collectives (all-gather params, reduce-scatter grads, allreduce over
+  tp) ride ICI;
+- per-layer ``jax.checkpoint`` (remat) trades FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import attention_reference, flash_attention
+from ..ops.ring_attention import ring_attention_shard_mapped
+from ..parallel.mesh import FSDP, SP, TP
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    tie_embeddings: bool = False
+    # 'flash' (pallas kernel), 'dense' (XLA reference), or 'ring'
+    # (sequence-parallel over the sp mesh axis; requires mesh context).
+    attention_impl: str = "flash"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+def llama3_8b(**overrides) -> LlamaConfig:
+    return dataclasses.replace(LlamaConfig(), **overrides)
+
+
+def tiny(**overrides) -> LlamaConfig:
+    """Test-scale config: real structure, toy widths."""
+    base = LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=128, dtype=jnp.float32, remat=False,
+        attention_impl="dense",
+    )
+    return dataclasses.replace(base, **overrides)
+
+
+def _rope(x, positions, theta: float):
+    """Rotary embeddings. x: [B, S, H, D_head]; positions: [B, S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones_init(), (x.shape[-1],))
+        xf = x.astype(jnp.float32)
+        norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    config: LlamaConfig
+    mesh: Optional[Any] = None  # required for attention_impl='ring'
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        b, s, _ = x.shape
+        hd = cfg.head_dim
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name=name,
+        )
+        q = dense(cfg.n_heads * hd, "wq")(x).reshape(b, s, cfg.n_heads, hd)
+        k = dense(cfg.n_kv_heads * hd, "wk")(x).reshape(b, s, cfg.n_kv_heads, hd)
+        v = dense(cfg.n_kv_heads * hd, "wv")(x).reshape(b, s, cfg.n_kv_heads, hd)
+
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+        # GQA: expand kv heads to query heads (the kernels are MHA-shaped;
+        # XLA fuses the broadcast into the batched matmul).
+        groups = cfg.n_heads // cfg.n_kv_heads
+        if groups > 1:
+            k = jnp.repeat(k, groups, axis=2)
+            v = jnp.repeat(v, groups, axis=2)
+
+        # [B, H, S, D] layout for the attention ops.
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        if cfg.attention_impl == "flash":
+            out = flash_attention(q, k, v, causal=True)
+        elif cfg.attention_impl == "ring":
+            if self.mesh is None or SP not in self.mesh.axis_names:
+                raise ValueError("attention_impl='ring' needs a mesh with an sp axis")
+            out = ring_attention_shard_mapped(q, k, v, self.mesh, causal=True)
+        else:
+            out = attention_reference(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
+        return dense(cfg.dim, "wo")(out)
+
+
+class MLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name=name,
+        )
+        gate = dense(cfg.ffn_dim, "w_gate")(x)
+        up = dense(cfg.ffn_dim, "w_up")(x)
+        return dense(cfg.dim, "w_down")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    config: LlamaConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        x = x + Attention(cfg, self.mesh, name="attn")(
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions
+        )
+        x = x + MLP(cfg, name="mlp")(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
+        return x
+
+
+class Llama(nn.Module):
+    config: LlamaConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1]), tokens.shape
+        )
+        emb = nn.Embed(
+            cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="embed",
+        )
+        h = emb(tokens)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.n_layers):
+            h = block(cfg, self.mesh, name=f"layer_{i}")(h, positions)
+        h = RMSNorm(cfg.norm_eps, name="final_norm")(h)
+        # Untied lm_head (Llama-3 does not tie embeddings); f32 logits for
+        # a stable softmax-CE.
+        if cfg.tie_embeddings:
+            return emb.attend(h.astype(jnp.float32))
+        return nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+            param_dtype=jnp.float32, name="lm_head",
+        )(h.astype(jnp.float32))
+
+
+def init_params(model: Llama, rng, batch: int = 2, seq: int = 16):
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    return model.init(rng, tokens)["params"]
+
+
+def loss_fn(model: Llama, params, tokens):
+    """Next-token cross-entropy. The full sequence goes through the model
+    (keeping the length divisible by the sp axis for ring attention); the
+    shift happens on the logits."""
+    logits = model.apply({"params": params}, tokens)
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], tokens[:, 1:]
+    )
+    return jnp.mean(ce)
+
+
+def make_train_step(model: Llama, optimizer):
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(model, p, tokens)
+        )(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def param_sharding_rules(mesh):
+    """(predicate, PartitionSpec) rules for ``parallel.shard_params``.
+
+    Megatron-style tensor parallelism: column-parallel qkv/gate/up
+    (output features over tp), row-parallel wo/down (input features over
+    tp), embeddings split vocab over tp; the other matrix dim takes fsdp.
+    Falls back gracefully when the mesh lacks a tp axis (pure FSDP).
+    """
+    names = set(mesh.axis_names)
+    tp = TP if TP in names else None
+    fsdp = FSDP if FSDP in names else None
+
+    def ends_with(*suffixes):
+        return lambda path, leaf: any(path.endswith(s) for s in suffixes)
+
+    return [
+        (ends_with("wq/kernel", "wk/kernel", "wv/kernel",
+                   "w_gate/kernel", "w_up/kernel"), P(fsdp, tp)),
+        (ends_with("wo/kernel", "w_down/kernel"), P(tp, fsdp)),
+        (ends_with("embed/embedding"), P(tp, fsdp)),
+        (ends_with("lm_head/kernel"), P(fsdp, tp)),
+        (ends_with("scale",), P()),
+    ]

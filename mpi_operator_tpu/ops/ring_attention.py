@@ -101,6 +101,38 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+def ring_spec(mesh, axis: str = SP):
+    """PartitionSpec for [B, H, S, D] ring-attention operands: batch over
+    dp×fsdp, sequence over the ring axis. The single source of truth for
+    how models and the standalone op lay these arrays out."""
+    batch_axes = tuple(a for a in (DP, FSDP) if a in mesh.axis_names)
+    return P(batch_axes if batch_axes else None, None, axis, None)
+
+
+def ring_attention_shard_mapped(
+    q, k, v,
+    mesh,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    axis: str = SP,
+):
+    """shard_map the per-shard ring kernel over the mesh — composable
+    inside a larger jitted computation (models call this directly)."""
+    from jax import shard_map
+
+    spec = ring_spec(mesh, axis)
+    fn = shard_map(
+        lambda a, b, c: ring_attention(
+            a, b, c, axis, causal=causal, sm_scale=sm_scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
 def ring_attention_sharded(
     q, k, v,
     mesh,
@@ -109,8 +141,8 @@ def ring_attention_sharded(
     sm_scale: Optional[float] = None,
     axis: str = SP,
 ):
-    """Global-view ring attention: shard_map the per-shard kernel over the
-    mesh, batch over dp×fsdp and sequence over ``axis``.
+    """Global-view ring attention: jit + placement around
+    ``ring_attention_shard_mapped`` for standalone use.
 
     Inputs are global [B, H, S, D] arrays (S divisible by the sp axis
     size); sharding constraints place them before the shard_map so XLA
@@ -118,23 +150,14 @@ def ring_attention_sharded(
     """
     if axis not in mesh.axis_names:
         return None  # caller should fall back to dense attention
-    from jax import shard_map
-
-    batch_axes = tuple(a for a in (DP, FSDP) if a in mesh.axis_names)
-    spec = P(batch_axes if batch_axes else None, None, axis, None)
+    spec = ring_spec(mesh, axis)
 
     @jax.jit
     def run(q, k, v):
         q_, k_, v_ = (jax.lax.with_sharding_constraint(x, spec) for x in (q, k, v))
-        fn = shard_map(
-            lambda a, b_, c: ring_attention(
-                a, b_, c, axis, causal=causal, sm_scale=sm_scale
-            ),
-            mesh=mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=spec,
+        return ring_attention_shard_mapped(
+            q_, k_, v_, mesh, causal=causal, sm_scale=sm_scale, axis=axis
         )
-        return fn(q_, k_, v_)
 
     with mesh:
         return run(q, k, v)
