@@ -108,7 +108,13 @@ class Bert(nn.Module):
         )(h)
         h = nn.gelu(h)
         h = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="mlm_norm")(h)
-        return embed.attend(h.astype(jnp.float32))
+        # Explicit f32 matmul for the tied decoder: Embed.attend promotes
+        # operands to the module dtype (bf16), losing the f32 logits.
+        return jnp.dot(
+            h.astype(jnp.float32),
+            embed.embedding.astype(jnp.float32).T,
+            preferred_element_type=jnp.float32,
+        )
 
 
 def init_params(model: Bert, rng, batch: int = 2, seq: int = 16):
@@ -139,13 +145,10 @@ def make_train_step(model: Bert, optimizer):
 
 def param_sharding_rules(mesh):
     """tp/fsdp rules for ``parallel.shard_params`` (see llama.py)."""
-    names = set(mesh.axis_names)
-    tp = TP if TP in names else None
-    fsdp = FSDP if FSDP in names else None
+    from ..parallel.sharding import ends_with, mesh_axis
 
-    def ends_with(*suffixes):
-        return lambda path, leaf: any(path.endswith(s) for s in suffixes)
-
+    tp = mesh_axis(mesh, TP)
+    fsdp = mesh_axis(mesh, FSDP)
     return [
         (ends_with("wq/kernel", "wk/kernel", "wv/kernel", "ffn_in/kernel"),
          P(fsdp, tp)),

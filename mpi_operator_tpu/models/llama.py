@@ -112,15 +112,14 @@ class Attention(nn.Module):
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
 
-        # GQA: expand kv heads to query heads (the kernels are MHA-shaped;
-        # XLA fuses the broadcast into the batched matmul).
-        groups = cfg.n_heads // cfg.n_kv_heads
-        if groups > 1:
-            k = jnp.repeat(k, groups, axis=2)
-            v = jnp.repeat(v, groups, axis=2)
-
-        # [B, H, S, D] layout for the attention ops.
+        # [B, H, S, D] layout. flash/ring take GQA-shaped kv natively (the
+        # kernels map query heads onto shared kv heads without expanding
+        # them in HBM); only the dense oracle needs the explicit repeat.
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        if cfg.attention_impl == "dense" and cfg.n_kv_heads != cfg.n_heads:
+            groups = cfg.n_heads // cfg.n_kv_heads
+            k = jnp.repeat(k, groups, axis=1)
+            v = jnp.repeat(v, groups, axis=1)
         if cfg.attention_impl == "flash":
             out = flash_attention(q, k, v, causal=True)
         elif cfg.attention_impl == "ring":
@@ -186,7 +185,13 @@ class Llama(nn.Module):
         # Untied lm_head (Llama-3 does not tie embeddings); f32 logits for
         # a stable softmax-CE.
         if cfg.tie_embeddings:
-            return emb.attend(h.astype(jnp.float32))
+            # Explicit f32 matmul: Embed.attend would promote back to the
+            # module dtype (bf16) and silently drop the f32 guarantee.
+            return jnp.dot(
+                h.astype(jnp.float32),
+                emb.embedding.astype(jnp.float32).T,
+                preferred_element_type=jnp.float32,
+            )
         return nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=jnp.float32,
             param_dtype=jnp.float32, name="lm_head",
@@ -229,13 +234,10 @@ def param_sharding_rules(mesh):
     tp), embeddings split vocab over tp; the other matrix dim takes fsdp.
     Falls back gracefully when the mesh lacks a tp axis (pure FSDP).
     """
-    names = set(mesh.axis_names)
-    tp = TP if TP in names else None
-    fsdp = FSDP if FSDP in names else None
+    from ..parallel.sharding import ends_with, mesh_axis
 
-    def ends_with(*suffixes):
-        return lambda path, leaf: any(path.endswith(s) for s in suffixes)
-
+    tp = mesh_axis(mesh, TP)
+    fsdp = mesh_axis(mesh, FSDP)
     return [
         (ends_with("wq/kernel", "wk/kernel", "wv/kernel",
                    "w_gate/kernel", "w_up/kernel"), P(fsdp, tp)),

@@ -177,14 +177,16 @@ def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref,
     dk_acc_ref, dv_acc_ref,
-    *, sm_scale, causal, q_len, kv_len, block_q, block_k,
+    *, sm_scale, causal, q_len, kv_len, block_q, block_k, nq,
 ):
-    # Grid: (batch*heads, k-blocks, q-blocks) — q innermost so dk/dv
-    # accumulate in VMEM across the q contraction.
-    j, i = pl.program_id(1), pl.program_id(2)
-    nq = pl.num_programs(2)
+    # Grid: (batch*kv-heads, k-blocks, group*q-blocks) — the innermost axis
+    # enumerates (query head in group, q block) so dk/dv accumulate in VMEM
+    # across the whole contraction for this kv head.
+    j, e = pl.program_id(1), pl.program_id(2)
+    i = e % nq
+    ne = pl.num_programs(2)
 
-    @pl.when(i == 0)
+    @pl.when(e == 0)
     def _init():
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
@@ -217,7 +219,7 @@ def _bwd_dkv_kernel(
     else:
         compute()
 
-    @pl.when(i == nq - 1)
+    @pl.when(e == ne - 1)
     def _finalize():
         dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
@@ -253,6 +255,9 @@ def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 def _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     bh, q_len, d = q.shape
     kv_len = k.shape[1]
+    # GQA: q rows map onto k/v rows `groups` apart via the BlockSpec index
+    # maps — kv heads are never expanded in HBM.
+    groups = bh // k.shape[0]
     qp = _pad_to(q, 1, block_q)
     kp = _pad_to(k, 1, block_k)
     vp = _pad_to(v, 1, block_k)
@@ -268,8 +273,8 @@ def _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -298,6 +303,7 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
     q, k, v, out, lse = res
     bh, q_len, d = q.shape
     kv_len = k.shape[1]
+    groups = bh // k.shape[0]
     # delta_i = rowsum(do_i * o_i): tiny elementwise reduce — let XLA fuse it.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
 
@@ -318,8 +324,8 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
@@ -330,20 +336,26 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap)
 
+    # dk/dv: one program per kv head; the inner grid axis enumerates every
+    # (query-head-in-group, q-block) pair so the accumulators also contract
+    # over the `groups` query heads sharing this kv head.
+    def qrow(b, e):
+        return b * groups + e // nq
+
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, **common),
-        grid=(bh, nk, nq),
+        functools.partial(_bwd_dkv_kernel, **common, nq=nq),
+        grid=(bh // groups, nk, nq * groups),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, e: (qrow(b, e), e % nq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, e: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, e: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, e: (qrow(b, e), e % nq, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, e: (qrow(b, e), e % nq)),
+            pl.BlockSpec((1, block_q), lambda b, j, e: (qrow(b, e), e % nq)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, e: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, e: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(kp.shape, k.dtype),
@@ -371,7 +383,11 @@ def flash_attention(
     block_k: int = 128,
     interpret: Optional[bool] = None,
 ):
-    """Flash attention. q [B, H, Sq, D]; k, v [B, H, Sk, D] → [B, H, Sq, D].
+    """Flash attention. q [B, H, Sq, D]; k, v [B, Hkv, Sk, D] → [B, H, Sq, D].
+
+    Hkv may divide H (grouped-query attention): kv heads are shared by
+    H/Hkv query heads through the kernels' index maps — never expanded in
+    HBM, so GQA's memory/bandwidth saving is real on both passes.
 
     Differentiable (custom VJP, both passes pallas). On non-TPU backends
     the kernels run in interpret mode so the same code path is testable
@@ -384,10 +400,12 @@ def flash_attention(
     if interpret is None:
         interpret = _use_interpret()
     b, h, q_len, d = q.shape
-    kv_len = k.shape[2]
+    h_kv, kv_len = k.shape[1], k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
     block_q = min(block_q, max(q_len, 1))
     block_k = min(block_k, max(kv_len, 1))
-    flat = lambda x: x.reshape(b * h, x.shape[2], d)
+    flat = lambda x: x.reshape(b * x.shape[1], x.shape[2], d)
     out = _flash(
         flat(q), flat(k), flat(v), sm_scale, causal, block_q, block_k, interpret
     )

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import DP, FSDP, SP
+from ..parallel.mesh import DP, FSDP, SP, TP
 
 NEG_INF = -1e30
 
@@ -54,7 +54,13 @@ def ring_attention(
         sm_scale = q.shape[-1] ** -0.5
 
     b, h, s_loc, d = q.shape
-    qf = q.astype(jnp.float32)
+    h_kv = k.shape[1]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    groups = h // h_kv
+    # GQA: group the q heads so only the h_kv-head k/v shards circulate the
+    # ring (1/groups of the ICI traffic of expanding kv up front).
+    qf = q.astype(jnp.float32).reshape(b, h_kv, groups, s_loc, d)
     row = my * s_loc + jnp.arange(s_loc)  # global row ids of the local q shard
 
     def step(carry, t):
@@ -64,11 +70,11 @@ def ring_attention(
         col = src * s_loc + jnp.arange(s_loc)  # global col ids of k_cur
 
         s = jnp.einsum(
-            "bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32),
+            "bhgqd,bhkd->bhgqk", qf, k_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         ) * sm_scale
         if causal:
-            mask = col[None, None, None, :] <= row[None, None, :, None]
+            mask = col[None, None, None, None, :] <= row[None, None, None, :, None]
             s = jnp.where(mask, s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -78,7 +84,7 @@ def ring_attention(
         correction = jnp.exp(m - m_new)
         l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * correction + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+            "bhgqk,bhkd->bhgqd", p, v_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
 
@@ -98,15 +104,22 @@ def ring_attention(
     )
     (acc, _, l, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
     out = acc / jnp.where(l > 0.0, l, 1.0)
-    return out.astype(q.dtype)
+    return out.reshape(b, h, s_loc, d).astype(q.dtype)
 
 
-def ring_spec(mesh, axis: str = SP):
+def ring_spec(mesh, axis: str = SP, n_heads: Optional[int] = None):
     """PartitionSpec for [B, H, S, D] ring-attention operands: batch over
-    dp×fsdp, sequence over the ring axis. The single source of truth for
-    how models and the standalone op lay these arrays out."""
-    batch_axes = tuple(a for a in (DP, FSDP) if a in mesh.axis_names)
-    return P(batch_axes if batch_axes else None, None, axis, None)
+    dp×fsdp, heads over tp (when the head count divides it), sequence over
+    the ring axis. The single source of truth for how models and the
+    standalone op lay these arrays out."""
+    names = mesh.axis_names
+    batch_axes = tuple(a for a in (DP, FSDP) if a in names)
+    head_axis = None
+    if n_heads is not None and TP in names:
+        tp_size = dict(zip(names, mesh.devices.shape))[TP]
+        if tp_size > 1 and n_heads % tp_size == 0:
+            head_axis = TP
+    return P(batch_axes if batch_axes else None, head_axis, axis, None)
 
 
 def ring_attention_shard_mapped(
@@ -118,17 +131,28 @@ def ring_attention_shard_mapped(
     axis: str = SP,
 ):
     """shard_map the per-shard ring kernel over the mesh — composable
-    inside a larger jitted computation (models call this directly)."""
+    inside a larger jitted computation (models call this directly).
+
+    When the mesh has a tp axis and both head counts divide it, heads ride
+    tp (each tp group runs an independent ring over its head slice instead
+    of all-gathering q/k/v and redoing the full attention tp times)."""
     from jax import shard_map
 
-    spec = ring_spec(mesh, axis)
+    hq, hkv = q.shape[1], k.shape[1]
+    tp_heads = (
+        hq if (ring_spec(mesh, axis, hq)[1] == TP
+               and ring_spec(mesh, axis, hkv)[1] == TP)
+        else None
+    )
+    q_spec = ring_spec(mesh, axis, tp_heads)
+    kv_spec = ring_spec(mesh, axis, hkv if tp_heads else None)
     fn = shard_map(
         lambda a, b, c: ring_attention(
             a, b, c, axis, causal=causal, sm_scale=sm_scale
         ),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
     )
     return fn(q, k, v)
 
@@ -150,7 +174,7 @@ def ring_attention_sharded(
     """
     if axis not in mesh.axis_names:
         return None  # caller should fall back to dense attention
-    spec = ring_spec(mesh, axis)
+    spec = ring_spec(mesh, axis)  # head-replicated placement for the inputs
 
     @jax.jit
     def run(q, k, v):

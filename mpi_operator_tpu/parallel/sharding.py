@@ -79,6 +79,19 @@ def shard_params(params, mesh, *, rules=None):
     return jax.tree_util.tree_map_with_path(place, params)
 
 
+def mesh_axis(mesh, name: str) -> Optional[str]:
+    """``name`` if the mesh has that axis, else None — lets model sharding
+    rules degrade gracefully (a P(None, ...) dim is just unsharded)."""
+    return name if name in mesh.axis_names else None
+
+
+def ends_with(*suffixes):
+    """Predicate factory for ``shard_params`` rules: matches a param whose
+    '/'-joined path ends with any suffix. Shared by the model families so
+    path-matching semantics cannot drift between them."""
+    return lambda path, leaf: any(path.endswith(s) for s in suffixes)
+
+
 def shard_batch(batch, mesh, *, sequence_axis: Optional[int] = None):
     """Place batch arrays on the mesh with `batch_spec`."""
     import jax

@@ -1,0 +1,65 @@
+"""SDK usage example: submit a JAX ResNet TPUJob and wait for completion.
+
+Analog of the reference SDK's usage example
+(/root/reference/sdk/python/v1/tensorflow-mnist.py), rebuilt for the
+TPUJob API: no launcher, no mpirun — every worker runs the same SPMD
+entrypoint and rendezvouses through jax.distributed.
+"""
+
+import pathlib
+import sys
+
+_HERE = pathlib.Path(__file__).resolve()
+sys.path.insert(0, str(_HERE.parent.parent))  # the SDK package
+sys.path.insert(0, str(_HERE.parents[4]))  # repo root, for the local demo backend
+
+from tpujob import (  # noqa: E402
+    TPUJobApi,
+    V2beta1ReplicaSpec,
+    V2beta1TPUJob,
+    V2beta1TPUJobSpec,
+    V2beta1TPUSpec,
+    operator_runtime_backend,
+)
+
+
+def build_job(name: str = "jax-resnet") -> V2beta1TPUJob:
+    worker = V2beta1ReplicaSpec(
+        replicas=4,
+        restart_policy="Never",
+        template={
+            "spec": {
+                "containers": [
+                    {
+                        "name": "worker",
+                        "image": "my-registry/jax-resnet:latest",
+                        "command": ["python", "train_resnet.py"],
+                    }
+                ]
+            }
+        },
+    )
+    return V2beta1TPUJob(
+        metadata={"name": name},
+        spec=V2beta1TPUJobSpec(
+            tpu=V2beta1TPUSpec(accelerator_type="v5e-16", topology="4x4"),
+            tpu_replica_specs={"Worker": worker},
+        ),
+    )
+
+
+def main() -> int:
+    # Local demo: drive the framework's in-memory backend. Against a real
+    # cluster, supply a backend adapting kubernetes CustomObjectsApi.
+    from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer
+
+    api = TPUJobApi(operator_runtime_backend(InMemoryAPIServer()))
+    job = api.create(build_job())
+    print(f"created TPUJob {job.name} ({job.spec.tpu.accelerator_type})")
+    listed = api.list()
+    print(f"jobs in namespace: {[j.name for j in listed.items]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,13 @@
+"""Packaging for the TPUJob SDK (reference analog:
+/root/reference/sdk/python/v1/setup.py)."""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="tpujob",
+    version="0.1.0",
+    description="Python SDK for the TPUJob API (kubeflow.org/v2beta1)",
+    packages=find_packages(include=["tpujob", "tpujob.*"]),
+    python_requires=">=3.10",
+    install_requires=[],  # dict-speaking backends keep the SDK dependency-free
+)

@@ -1,0 +1,137 @@
+"""TPUJob API client.
+
+Analog of the reference SDK's ``api_client.py`` + ``MPIJobClient``
+usage pattern (/root/reference/sdk/python/v1/mpijob/api_client.py,
+sdk/python/v1/tensorflow-mnist.py): a thin, typed CRUD surface over a
+pluggable backend. The backend protocol is four dict-speaking methods,
+so the same SDK code drives:
+
+- the framework's in-memory apiserver (tests, local dev):
+  ``operator_runtime_backend()``;
+- a real cluster, by adapting the official kubernetes
+  ``CustomObjectsApi`` (not imported here — zero hard dependencies).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable, Optional, Protocol
+
+from .models import V2beta1TPUJob, V2beta1TPUJobList
+
+GROUP = "kubeflow.org"
+VERSION = "v2beta1"
+PLURAL = "tpujobs"
+
+
+class TPUJobBackend(Protocol):
+    """Dict-level CRUD for the tpujobs resource."""
+
+    def create(self, namespace: str, body: dict) -> dict: ...
+
+    def get(self, namespace: str, name: str) -> dict: ...
+
+    def list(self, namespace: str) -> Iterable[dict]: ...
+
+    def update(self, namespace: str, name: str, body: dict) -> dict: ...
+
+    def delete(self, namespace: str, name: str) -> None: ...
+
+
+class TPUJobApi:
+    """Typed TPUJob operations over a ``TPUJobBackend``."""
+
+    def __init__(self, backend: TPUJobBackend, namespace: str = "default"):
+        self._backend = backend
+        self.namespace = namespace
+
+    def _ns(self, namespace: Optional[str]) -> str:
+        return namespace or self.namespace
+
+    def create(self, job: V2beta1TPUJob, namespace: Optional[str] = None) -> V2beta1TPUJob:
+        return V2beta1TPUJob.from_dict(
+            self._backend.create(self._ns(namespace), job.to_dict())
+        )
+
+    def get(self, name: str, namespace: Optional[str] = None) -> V2beta1TPUJob:
+        return V2beta1TPUJob.from_dict(self._backend.get(self._ns(namespace), name))
+
+    def list(self, namespace: Optional[str] = None) -> V2beta1TPUJobList:
+        items = [
+            V2beta1TPUJob.from_dict(d) for d in self._backend.list(self._ns(namespace))
+        ]
+        return V2beta1TPUJobList(
+            api_version=f"{GROUP}/{VERSION}", kind="TPUJobList", items=items
+        )
+
+    def update(self, job: V2beta1TPUJob, namespace: Optional[str] = None) -> V2beta1TPUJob:
+        return V2beta1TPUJob.from_dict(
+            self._backend.update(self._ns(namespace), job.name, job.to_dict())
+        )
+
+    def patch_worker_replicas(
+        self, name: str, replicas: int, namespace: Optional[str] = None
+    ) -> V2beta1TPUJob:
+        """Elastic resize: the SDK-side of the reference's
+        'patch spec.mpiReplicaSpecs.Worker.replicas' flow (SURVEY.md §3.4)."""
+        job = self.get(name, namespace)
+        job.spec.tpu_replica_specs["Worker"].replicas = replicas
+        return self.update(job, namespace)
+
+    def delete(self, name: str, namespace: Optional[str] = None) -> None:
+        self._backend.delete(self._ns(namespace), name)
+
+    def wait_for_condition(
+        self,
+        name: str,
+        cond_type: str,
+        timeout: float = 300.0,
+        poll_interval: float = 0.5,
+        namespace: Optional[str] = None,
+    ) -> V2beta1TPUJob:
+        """Poll until ``cond_type`` is True (the SDK analog of the e2e
+        suite's createJobAndWaitForCompletion,
+        /root/reference/v2/test/e2e/mpi_job_test.go:213-237)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            job = self.get(name, namespace)
+            if job.condition(cond_type) is not None:
+                return job
+            if job.failed and cond_type != "Failed":
+                raise RuntimeError(f"TPUJob {name} failed while waiting for {cond_type}")
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"TPUJob {name} did not reach condition {cond_type} "
+                    f"within {timeout:.0f}s"
+                )
+            time.sleep(poll_interval)
+
+
+class _OperatorRuntimeBackend:
+    """Adapter over the framework's in-memory apiserver (runtime.apiserver)."""
+
+    def __init__(self, api_server):
+        self._api = api_server
+
+    def create(self, namespace: str, body: dict) -> dict:
+        body.setdefault("metadata", {}).setdefault("namespace", namespace)
+        return self._api.create(PLURAL, body)
+
+    def get(self, namespace: str, name: str) -> dict:
+        return self._api.get(PLURAL, namespace, name)
+
+    def list(self, namespace: str):
+        return self._api.list(PLURAL, namespace, None)
+
+    def update(self, namespace: str, name: str, body: dict) -> dict:
+        body.setdefault("metadata", {}).setdefault("namespace", namespace)
+        return self._api.update(PLURAL, body)
+
+    def delete(self, namespace: str, name: str) -> None:
+        self._api.delete(PLURAL, namespace, name)
+
+
+def operator_runtime_backend(api_server) -> TPUJobBackend:
+    """Wrap an ``mpi_operator_tpu.runtime.apiserver.InMemoryAPIServer``
+    (or anything with its surface) as an SDK backend."""
+    return _OperatorRuntimeBackend(api_server)

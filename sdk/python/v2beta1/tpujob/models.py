@@ -1,0 +1,283 @@
+"""Swagger-style model classes for the TPUJob API.
+
+Conventions follow the reference's generated SDK models
+(/root/reference/sdk/python/v1/mpijob/models/v1_mpi_job.py and siblings):
+each class declares ``openapi_types`` and ``attribute_map`` (snake_case
+attribute → camelCase wire name), and provides ``to_dict`` /
+``from_dict`` that round-trip the wire format. Unknown wire fields are
+preserved through a round trip so the SDK never strips server-added
+fields it does not know about.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Optional
+
+
+class _Model:
+    """Base: wire <-> attribute mapping driven by ``attribute_map``.
+
+    ``openapi_types`` values are either a model class (nested object),
+    ``list[Model]``-style tuples ``("list", Model)``, ``("dict", Model)``,
+    or a plain python type; plain values pass through untouched.
+    """
+
+    openapi_types: dict[str, Any] = {}
+    attribute_map: dict[str, str] = {}
+
+    def __init__(self, **kwargs):
+        self._extra: dict[str, Any] = {}
+        for attr in self.openapi_types:
+            setattr(self, attr, kwargs.pop(attr, None))
+        if kwargs:
+            raise TypeError(
+                f"{type(self).__name__} got unexpected arguments {sorted(kwargs)}"
+            )
+
+    @staticmethod
+    def _serialize(value):
+        if isinstance(value, _Model):
+            return value.to_dict()
+        if isinstance(value, list):
+            return [_Model._serialize(v) for v in value]
+        if isinstance(value, dict):
+            return {k: _Model._serialize(v) for k, v in value.items()}
+        return value
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {}
+        for attr, wire in self.attribute_map.items():
+            value = getattr(self, attr)
+            if value is None:
+                continue
+            out[wire] = self._serialize(value)
+        for wire, value in self._extra.items():
+            out.setdefault(wire, copy.deepcopy(value))
+        return out
+
+    @classmethod
+    def _deserialize(cls, typ, value):
+        if value is None:
+            return None
+        if isinstance(typ, tuple):
+            kind, item = typ
+            if kind == "list":
+                return [cls._deserialize(item, v) for v in value]
+            return {k: cls._deserialize(item, v) for k, v in value.items()}
+        if isinstance(typ, type) and issubclass(typ, _Model):
+            return typ.from_dict(value)
+        return copy.deepcopy(value)
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]):
+        d = dict(d or {})
+        kwargs = {}
+        for attr, wire in cls.attribute_map.items():
+            if wire in d:
+                kwargs[attr] = cls._deserialize(cls.openapi_types[attr], d.pop(wire))
+        obj = cls(**kwargs)
+        obj._extra = copy.deepcopy(d)  # preserve unknown server fields
+        return obj
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_dict() == other.to_dict()
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.to_dict()!r})"
+
+
+class V2beta1SchedulingPolicy(_Model):
+    openapi_types = {
+        "min_available": int,
+        "queue": str,
+        "priority_class": str,
+    }
+    attribute_map = {
+        "min_available": "minAvailable",
+        "queue": "queue",
+        "priority_class": "priorityClass",
+    }
+
+
+class V2beta1RunPolicy(_Model):
+    openapi_types = {
+        "clean_pod_policy": str,
+        "ttl_seconds_after_finished": int,
+        "active_deadline_seconds": int,
+        "backoff_limit": int,
+        "scheduling_policy": V2beta1SchedulingPolicy,
+        "suspend": bool,
+    }
+    attribute_map = {
+        "clean_pod_policy": "cleanPodPolicy",
+        "ttl_seconds_after_finished": "ttlSecondsAfterFinished",
+        "active_deadline_seconds": "activeDeadlineSeconds",
+        "backoff_limit": "backoffLimit",
+        "scheduling_policy": "schedulingPolicy",
+        "suspend": "suspend",
+    }
+
+
+class V2beta1TPUSpec(_Model):
+    openapi_types = {
+        "accelerator_type": str,
+        "topology": str,
+        "num_slices": int,
+        "runtime_version": str,
+    }
+    attribute_map = {
+        "accelerator_type": "acceleratorType",
+        "topology": "topology",
+        "num_slices": "numSlices",
+        "runtime_version": "runtimeVersion",
+    }
+
+
+class V2beta1JAXDistributionSpec(_Model):
+    openapi_types = {
+        "coordinator_port": int,
+        "heartbeat_timeout_seconds": int,
+    }
+    attribute_map = {
+        "coordinator_port": "coordinatorPort",
+        "heartbeat_timeout_seconds": "heartbeatTimeoutSeconds",
+    }
+
+
+class V2beta1ReplicaSpec(_Model):
+    openapi_types = {
+        "replicas": int,
+        "restart_policy": str,
+        "template": dict,
+    }
+    attribute_map = {
+        "replicas": "replicas",
+        "restart_policy": "restartPolicy",
+        "template": "template",
+    }
+
+
+class V2beta1TPUJobSpec(_Model):
+    openapi_types = {
+        "tpu": V2beta1TPUSpec,
+        "jax_distribution": V2beta1JAXDistributionSpec,
+        "run_policy": V2beta1RunPolicy,
+        "tpu_replica_specs": ("dict", V2beta1ReplicaSpec),
+    }
+    attribute_map = {
+        "tpu": "tpu",
+        "jax_distribution": "jaxDistribution",
+        "run_policy": "runPolicy",
+        "tpu_replica_specs": "tpuReplicaSpecs",
+    }
+
+
+class V2beta1JobCondition(_Model):
+    openapi_types = {
+        "type": str,
+        "status": str,
+        "reason": str,
+        "message": str,
+        "last_update_time": float,
+        "last_transition_time": float,
+    }
+    attribute_map = {
+        "type": "type",
+        "status": "status",
+        "reason": "reason",
+        "message": "message",
+        "last_update_time": "lastUpdateTime",
+        "last_transition_time": "lastTransitionTime",
+    }
+
+
+class V2beta1ReplicaStatus(_Model):
+    openapi_types = {
+        "active": int,
+        "succeeded": int,
+        "failed": int,
+    }
+    attribute_map = {
+        "active": "active",
+        "succeeded": "succeeded",
+        "failed": "failed",
+    }
+
+
+class V2beta1JobStatus(_Model):
+    openapi_types = {
+        "conditions": ("list", V2beta1JobCondition),
+        "replica_statuses": ("dict", V2beta1ReplicaStatus),
+        "start_time": float,
+        "completion_time": float,
+        "last_reconcile_time": float,
+    }
+    attribute_map = {
+        "conditions": "conditions",
+        "replica_statuses": "replicaStatuses",
+        "start_time": "startTime",
+        "completion_time": "completionTime",
+        "last_reconcile_time": "lastReconcileTime",
+    }
+
+
+class V2beta1TPUJob(_Model):
+    openapi_types = {
+        "api_version": str,
+        "kind": str,
+        "metadata": dict,
+        "spec": V2beta1TPUJobSpec,
+        "status": V2beta1JobStatus,
+    }
+    attribute_map = {
+        "api_version": "apiVersion",
+        "kind": "kind",
+        "metadata": "metadata",
+        "spec": "spec",
+        "status": "status",
+    }
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        if self.api_version is None:
+            self.api_version = "kubeflow.org/v2beta1"
+        if self.kind is None:
+            self.kind = "TPUJob"
+
+    @property
+    def name(self) -> str:
+        return (self.metadata or {}).get("name", "")
+
+    @property
+    def namespace(self) -> str:
+        return (self.metadata or {}).get("namespace", "")
+
+    def condition(self, cond_type: str) -> Optional[V2beta1JobCondition]:
+        for c in (self.status.conditions if self.status else None) or []:
+            if c.type == cond_type and c.status == "True":
+                return c
+        return None
+
+    @property
+    def succeeded(self) -> bool:
+        return self.condition("Succeeded") is not None
+
+    @property
+    def failed(self) -> bool:
+        return self.condition("Failed") is not None
+
+
+class V2beta1TPUJobList(_Model):
+    openapi_types = {
+        "api_version": str,
+        "kind": str,
+        "metadata": dict,
+        "items": ("list", V2beta1TPUJob),
+    }
+    attribute_map = {
+        "api_version": "apiVersion",
+        "kind": "kind",
+        "metadata": "metadata",
+        "items": "items",
+    }
