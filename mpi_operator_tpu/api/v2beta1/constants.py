@@ -52,6 +52,14 @@ ENV_SLICE_ID = "TPUJOB_SLICE_ID"
 DEFAULT_COORDINATOR_PORT = 8476  # jax.distributed's conventional port
 DEFAULT_CLEAN_POD_POLICY = "None"
 
+# Elastic restart/rejoin (BASELINE.md milestone 5): every worker pod is
+# stamped with the world size its rendezvous env was rendered for.  A
+# resize makes the stamp stale; unlike Elastic Horovod (which re-execs
+# discover_hosts.sh without restarting, proposals/elastic-horovod.md),
+# jax.distributed cannot change world size in place, so the controller
+# restarts stale pods with fresh env — honest restart-and-rejoin.
+WORLD_SIZE_ANNOTATION = "tpujob.kubeflow.org/world-size"
+
 # ConfigMap keys (hostfile/discover_hosts.sh analogs,
 # mpi_job_controller.go:1106-1145).
 CONFIG_SUFFIX = "-config"

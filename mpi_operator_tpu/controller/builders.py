@@ -194,6 +194,8 @@ def new_worker(job: TPUJob, index: int, gang_scheduler_name: str = "") -> KubeOb
     labels.update(default_labels(job.name, constants.ROLE_WORKER))
     labels[constants.REPLICA_INDEX_LABEL] = str(index)
     annotations = dict(tmeta.get("annotations") or {})
+    # Elastic stamp: which world size this pod's rendezvous env encodes.
+    annotations[constants.WORLD_SIZE_ANNOTATION] = str(worker_replicas(job))
 
     name = worker_name(job, index)
     pod_spec["hostname"] = name

@@ -30,6 +30,7 @@ TPUJOB_SUCCEEDED_REASON = "TPUJobSucceeded"
 TPUJOB_RUNNING_REASON = "TPUJobRunning"
 TPUJOB_FAILED_REASON = "TPUJobFailed"
 TPUJOB_EVICTED_REASON = "TPUJobEvicted"
+TPUJOB_RESTARTING_REASON = "TPUJobRestarting"
 TPUJOB_SUSPENDED_REASON = "TPUJobSuspended"
 TPUJOB_RESUMED_REASON = "TPUJobResumed"
 

@@ -32,12 +32,14 @@ from ..api.v2beta1.types import (
     GROUP_NAME,
     JOB_CREATED,
     JOB_FAILED,
+    JOB_RESTARTING,
     JOB_RUNNING,
     JOB_SUCCEEDED,
     JOB_SUSPENDED,
     KIND,
     REPLICA_TYPE_LAUNCHER,
     REPLICA_TYPE_WORKER,
+    RESTART_POLICY_ON_FAILURE,
     ReplicaStatus,
     TPUJob,
 )
@@ -531,9 +533,37 @@ class TPUJobController:
                     except NotFoundError:
                         pass
 
+        restarted: list[str] = []
         for i in range(replicas):
             name = builders.worker_name(job, i)
             pod = self.pod_informer.lister.get(job.namespace, name)
+            if pod is not None and is_controlled_by(pod, job):
+                reason = self._elastic_restart_reason(job, pod, replicas)
+                if reason is not None:
+                    # The cache can lag a restart this controller just did
+                    # (another sync raced the pump thread): confirm against
+                    # the apiserver before deleting, or a fresh correct pod
+                    # gets spuriously restarted again.
+                    try:
+                        fresh = self.kube.pods(job.namespace).get(name).to_dict()
+                    except NotFoundError:
+                        fresh = None
+                    reason = (
+                        self._elastic_restart_reason(job, fresh, replicas)
+                        if fresh is not None
+                        else None
+                    )
+                    if fresh is None:
+                        pod = None  # already gone; recreate below
+                    elif reason is not None:
+                        try:
+                            self.kube.pods(job.namespace).delete(name)
+                        except NotFoundError:
+                            pass
+                        restarted.append(f"{name} ({reason})")
+                        pod = None  # recreate below with fresh rendezvous env
+                    else:
+                        pod = fresh  # cache was stale; pod is already correct
             if pod is None:
                 try:
                     pod = (
@@ -554,7 +584,50 @@ class TPUJobController:
                 self._flag_not_controlled(job, pod)
                 raise RuntimeError(f"worker Pod {name} not controlled by us")
             out.append(pod)
+
+        if restarted:
+            msg = truncate_message(
+                f"restarting workers for rejoin (world size {replicas}): "
+                + ", ".join(restarted)
+            )
+            st.update_job_conditions(
+                job,
+                JOB_RESTARTING,
+                st.TPUJOB_RESTARTING_REASON,
+                msg,
+                now=self.clock(),
+            )
+            self.recorder.event(
+                job, EVENT_TYPE_NORMAL, st.TPUJOB_RESTARTING_REASON, msg
+            )
         return out
+
+    def _elastic_restart_reason(
+        self, job: TPUJob, pod: dict, replicas: int
+    ) -> Optional[str]:
+        """Why this worker pod must be replaced, or None to keep it.
+
+        Two triggers (BASELINE.md milestone 5, SURVEY.md §3.4 analog):
+        - stale world size: the pod's rendezvous env was rendered for a
+          different replica count (elastic resize) — jax.distributed cannot
+          resize in place, so the gang restarts and rejoins;
+        - failed worker under restartPolicy=OnFailure: preempted/evicted
+          slice hosts come back by pod replacement (kubelet only restarts
+          containers in-place; a deleted/failed pod needs the controller).
+        """
+        annotations = pod["metadata"].get("annotations") or {}
+        stamp = annotations.get(constants.WORLD_SIZE_ANNOTATION)
+        if stamp != str(replicas):
+            # A missing stamp (pre-upgrade pod, stripped annotation) is
+            # treated as stale: keeping it would leave its rendezvous env
+            # encoding an unknown world size and hang the gang.
+            return f"world size {stamp or 'unknown'} -> {replicas}"
+        worker_spec = job.spec.replica_specs.get(REPLICA_TYPE_WORKER)
+        restart_policy = worker_spec.restart_policy if worker_spec else ""
+        if restart_policy == RESTART_POLICY_ON_FAILURE and _pod_phase(pod) == POD_FAILED:
+            reason = (pod.get("status") or {}).get("reason", "")
+            return f"failed{f' ({reason})' if reason else ''}"
+        return None
 
     def _delete_worker_pods(self, job: TPUJob) -> None:
         """deleteWorkerPods :860-900 analog (cleanPodPolicy-aware)."""
@@ -613,13 +686,20 @@ class TPUJobController:
 
     def _workers_done(self, job: TPUJob, workers: list[dict]) -> bool:
         """Launcher-less doneness: every worker pod exists and Succeeded, or
-        any worker Failed (with restartPolicy Never the kubelet won't bring
-        it back, so the gang can never complete)."""
+        any worker Failed under restartPolicy Never (the kubelet won't bring
+        it back, so the gang can never complete). Under OnFailure a Failed
+        pod is *not* terminal — the controller replaces it for elastic
+        rejoin (_elastic_restart_reason)."""
         replicas = builders.worker_replicas(job)
         if replicas == 0 or len(workers) < replicas:
             return False
+        worker_spec = job.spec.replica_specs.get(REPLICA_TYPE_WORKER)
+        restart_policy = worker_spec.restart_policy if worker_spec else ""
         phases = [_pod_phase(p) for p in workers]
-        if any(p == POD_FAILED for p in phases):
+        if (
+            restart_policy != RESTART_POLICY_ON_FAILURE
+            and any(p == POD_FAILED for p in phases)
+        ):
             return True
         return all(p == POD_SUCCEEDED for p in phases)
 

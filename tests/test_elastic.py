@@ -1,0 +1,183 @@
+"""Elastic restart/rejoin (BASELINE.md milestone 5).
+
+The reference's elasticity is Elastic Horovod re-execing
+discover_hosts.sh without restart (SURVEY.md §3.4); jax.distributed
+cannot resize a world in place, so our controller's contract is honest
+restart-and-rejoin: pods whose rendezvous env encodes a stale world size
+are replaced, and failed/preempted workers under restartPolicy=OnFailure
+are replaced rather than failing the job.
+"""
+
+from mpi_operator_tpu.api.v2beta1 import constants
+from mpi_operator_tpu.api.v2beta1.types import (
+    JOB_FAILED,
+    JOB_RESTARTING,
+    REPLICA_TYPE_WORKER,
+)
+from mpi_operator_tpu.controller import builders
+from mpi_operator_tpu.controller import status as st
+
+from tests.test_controller import Fixture, make_synced_job
+
+
+def _worker_env(api, name: str) -> dict:
+    pod = api.get("pods", "default", name)
+    env = pod["spec"]["containers"][0]["env"]
+    return {e["name"]: e["value"] for e in env}
+
+
+class TestElasticResize:
+    def test_scale_down_restarts_survivors_with_new_world(self):
+        # v5e-16 x 2 slices = 8 workers -> 1 slice = 4 workers.
+        f = Fixture()
+        job = f.new_job(workers=8)
+        job.spec.tpu.num_slices = 2
+        f.start()
+        created = f.create_job(job)
+        f.sync(created)
+        assert len(f.api.list("pods", "default", None)) == 8
+        assert (
+            _worker_env(f.api, "test-job-worker-0")[constants.ENV_NUM_PROCESSES]
+            == "8"
+        )
+
+        live = f.get_job()
+        live.spec.tpu.num_slices = 1
+        live.spec.replica_specs[REPLICA_TYPE_WORKER].replicas = 4
+        f.controller.tpujobs.tpujobs("default").update(live)
+        f.sync(live)
+        # One more pass: survivors deleted for staleness are recreated in
+        # the same sync; scale-down victims are just deleted.
+        pods = f.api.list("pods", "default", None)
+        assert len(pods) == 4
+        env = _worker_env(f.api, "test-job-worker-0")
+        assert env[constants.ENV_NUM_PROCESSES] == "4"
+        stamped = f.api.get("pods", "default", "test-job-worker-0")["metadata"][
+            "annotations"
+        ][constants.WORLD_SIZE_ANNOTATION]
+        assert stamped == "4"
+        status = f.get_job().status
+        assert st.has_condition(status, JOB_RESTARTING)
+        assert ("Normal", st.TPUJOB_RESTARTING_REASON) in f.events()
+
+    def test_scale_up_restamps_all_workers(self):
+        f = Fixture()
+        job = f.new_job(workers=4)
+        f.start()
+        created = f.create_job(job)
+        f.sync(created)
+
+        live = f.get_job()
+        live.spec.tpu.num_slices = 2
+        live.spec.replica_specs[REPLICA_TYPE_WORKER].replicas = 8
+        f.controller.tpujobs.tpujobs("default").update(live)
+        f.sync(live)
+        pods = f.api.list("pods", "default", None)
+        assert len(pods) == 8
+        for pod in pods:
+            envs = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+            assert envs[constants.ENV_NUM_PROCESSES] == "8"
+
+    def test_missing_stamp_treated_as_stale(self):
+        # Pre-upgrade pods without the annotation get restarted so their
+        # (unknown) rendezvous env cannot poison the gang.
+        f = Fixture()
+        job = make_synced_job(f)
+        pod = f.api.get("pods", "default", "test-job-worker-1")
+        del pod["metadata"]["annotations"][constants.WORLD_SIZE_ANNOTATION]
+        f.api.update("pods", pod)
+        uid_before = pod["metadata"]["uid"]
+        f.sync(job)
+        after = f.api.get("pods", "default", "test-job-worker-1")
+        assert after["metadata"]["uid"] != uid_before
+        assert (
+            after["metadata"]["annotations"][constants.WORLD_SIZE_ANNOTATION] == "4"
+        )
+
+    def test_stale_cache_does_not_double_restart(self):
+        # The restart decision is confirmed against the apiserver: if the
+        # cached pod is outdated but the live pod is already correct, the
+        # live pod is kept.
+        f = Fixture()
+        job = f.new_job(workers=8)
+        job.spec.tpu.num_slices = 2
+        f.start()
+        created = f.create_job(job)
+        f.sync(created)
+        # Resize; sync once so pods are restarted with world size 4.
+        live = f.get_job()
+        live.spec.tpu.num_slices = 1
+        live.spec.replica_specs[REPLICA_TYPE_WORKER].replicas = 4
+        f.controller.tpujobs.tpujobs("default").update(live)
+        f.sync(live)
+        uid = f.api.get("pods", "default", "test-job-worker-0")["metadata"]["uid"]
+        # Poison the informer cache with the pre-resize pod (stamp "8") to
+        # simulate a lagging pump; the sync must keep the live pod.
+        stale = f.api.get("pods", "default", "test-job-worker-0")
+        stale = {**stale, "metadata": {**stale["metadata"], "annotations": {
+            **stale["metadata"]["annotations"],
+            constants.WORLD_SIZE_ANNOTATION: "8",
+        }}}
+        f.controller.pod_informer._cache["default/test-job-worker-0"] = stale
+        f.controller.sync_handler("default/test-job-job")  # unrelated key no-op
+        f.controller.sync_handler(f"default/{live.name}")
+        after = f.api.get("pods", "default", "test-job-worker-0")
+        assert after["metadata"]["uid"] == uid  # not re-restarted
+
+    def test_steady_state_does_not_restart(self):
+        f = Fixture()
+        job = make_synced_job(f)
+        uid_before = f.api.get("pods", "default", "test-job-worker-0")["metadata"]["uid"]
+        f.sync(job)
+        f.sync(job)
+        uid_after = f.api.get("pods", "default", "test-job-worker-0")["metadata"]["uid"]
+        assert uid_before == uid_after
+        assert not st.has_condition(f.get_job().status, JOB_RESTARTING)
+
+
+class TestFailedWorkerRejoin:
+    def test_on_failure_replaces_failed_worker(self):
+        f = Fixture()
+        job = f.new_job(workers=4)
+        job.spec.replica_specs[REPLICA_TYPE_WORKER].restart_policy = "OnFailure"
+        f.start()
+        created = f.create_job(job)
+        f.sync(created)
+        uid_before = f.api.get("pods", "default", "test-job-worker-2")["metadata"]["uid"]
+        f.set_pod_phase("test-job-worker-2", "Failed", reason="Evicted")
+        f.sync(created)
+
+        pod = f.api.get("pods", "default", "test-job-worker-2")
+        assert pod["metadata"]["uid"] != uid_before  # replaced, not kept
+        status = f.get_job().status
+        assert st.has_condition(status, JOB_RESTARTING)
+        assert not st.is_failed(status)  # eviction did not kill the job
+
+    def test_never_policy_fails_job_on_eviction(self):
+        f = Fixture()
+        job = make_synced_job(f)  # default restartPolicy Never
+        f.set_pod_phase("test-job-worker-1", "Failed", reason="Evicted")
+        f.sync(job)
+        status = f.get_job().status
+        assert st.is_failed(status)
+        cond = st.get_condition(status, JOB_FAILED)
+        assert cond.reason == st.TPUJOB_EVICTED_REASON
+
+    def test_discover_hosts_tracks_membership(self):
+        f = Fixture()
+        job = f.new_job(workers=4)
+        job.spec.replica_specs[REPLICA_TYPE_WORKER].restart_policy = "OnFailure"
+        f.start()
+        job = f.create_job(job)
+        f.sync(job)
+        f.set_all_workers_phase(job, "Running")
+        f.sync(job)
+        cm = f.api.get("configmaps", "default", builders.config_name(job))
+        script = cm["data"][constants.DISCOVER_HOSTS_KEY]
+        assert script.count("test-job-worker-") == 4
+        # A worker dies; it is replaced (Pending, not yet Running), so the
+        # membership script shrinks to the 3 live ranks on the next sync.
+        f.set_pod_phase("test-job-worker-3", "Failed")
+        f.sync(job)
+        cm = f.api.get("configmaps", "default", builders.config_name(job))
+        assert cm["data"][constants.DISCOVER_HOSTS_KEY].count("test-job-worker-") == 3
