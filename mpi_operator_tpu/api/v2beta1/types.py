@@ -317,6 +317,10 @@ class ReplicaStatus:
     active: int = 0
     succeeded: int = 0
     failed: int = 0
+    # Cumulative failure-replacements for launcher-less elastic jobs (the
+    # analog of a batch Job's retry count: runPolicy.backoffLimit bounds
+    # it). Unlike active/succeeded/failed this survives pod replacement.
+    restarts: int = 0
 
     def to_dict(self) -> dict:
         d: dict[str, Any] = {}
@@ -326,6 +330,8 @@ class ReplicaStatus:
             d["succeeded"] = self.succeeded
         if self.failed:
             d["failed"] = self.failed
+        if self.restarts:
+            d["restarts"] = self.restarts
         return d
 
     @classmethod
@@ -335,6 +341,7 @@ class ReplicaStatus:
             active=int(d.get("active", 0) or 0),
             succeeded=int(d.get("succeeded", 0) or 0),
             failed=int(d.get("failed", 0) or 0),
+            restarts=int(d.get("restarts", 0) or 0),
         )
 
 

@@ -39,8 +39,13 @@ CONDITION_FALSE = "False"
 
 
 def initialize_replica_statuses(job: TPUJob, replica_type: str) -> None:
-    """:38-46 analog: reset one replica type's counters."""
-    job.status.replica_statuses[replica_type] = ReplicaStatus()
+    """:38-46 analog: reset one replica type's per-sync counters. The
+    cumulative ``restarts`` counter survives (it bounds elastic
+    replacement via runPolicy.backoffLimit)."""
+    prior = job.status.replica_statuses.get(replica_type)
+    job.status.replica_statuses[replica_type] = ReplicaStatus(
+        restarts=prior.restarts if prior else 0
+    )
 
 
 def new_condition(

@@ -533,12 +533,29 @@ class TPUJobController:
                     except NotFoundError:
                         pass
 
+        # Failure-replacement preconditions: the gang must be rejoinable
+        # (no rank already exited Succeeded — those processes are gone and
+        # a new rank could never rendezvous with them) and the restart
+        # budget (runPolicy.backoffLimit) must not be exhausted.
+        any_succeeded = any(_pod_phase(p) == POD_SUCCEEDED for p in existing)
+        backoff = job.spec.run_policy.backoff_limit
+        wstatus = job.status.replica_statuses.setdefault(
+            REPLICA_TYPE_WORKER, ReplicaStatus()
+        )
+
+        def may_restart_failed() -> bool:
+            if any_succeeded:
+                return False
+            return backoff is None or wstatus.restarts < backoff
+
         restarted: list[str] = []
         for i in range(replicas):
             name = builders.worker_name(job, i)
             pod = self.pod_informer.lister.get(job.namespace, name)
             if pod is not None and is_controlled_by(pod, job):
-                reason = self._elastic_restart_reason(job, pod, replicas)
+                reason = self._elastic_restart_reason(
+                    job, pod, replicas, allow_failure_restart=may_restart_failed()
+                )
                 if reason is not None:
                     # The cache can lag a restart this controller just did
                     # (another sync raced the pump thread): confirm against
@@ -549,7 +566,10 @@ class TPUJobController:
                     except NotFoundError:
                         fresh = None
                     reason = (
-                        self._elastic_restart_reason(job, fresh, replicas)
+                        self._elastic_restart_reason(
+                            job, fresh, replicas,
+                            allow_failure_restart=may_restart_failed(),
+                        )
                         if fresh is not None
                         else None
                     )
@@ -560,6 +580,8 @@ class TPUJobController:
                             self.kube.pods(job.namespace).delete(name)
                         except NotFoundError:
                             pass
+                        if reason.startswith("failed"):
+                            wstatus.restarts += 1  # counts against backoffLimit
                         restarted.append(f"{name} ({reason})")
                         pod = None  # recreate below with fresh rendezvous env
                     else:
@@ -603,9 +625,11 @@ class TPUJobController:
         return out
 
     def _elastic_restart_reason(
-        self, job: TPUJob, pod: dict, replicas: int
+        self, job: TPUJob, pod: dict, replicas: int, *, allow_failure_restart: bool
     ) -> Optional[str]:
         """Why this worker pod must be replaced, or None to keep it.
+        Failure-replacement reasons always start with "failed" (they count
+        against runPolicy.backoffLimit); stale-stamp reasons do not.
 
         Two triggers (BASELINE.md milestone 5, SURVEY.md §3.4 analog):
         - stale world size: the pod's rendezvous env was rendered for a
@@ -613,7 +637,8 @@ class TPUJobController:
           resize in place, so the gang restarts and rejoins;
         - failed worker under restartPolicy=OnFailure: preempted/evicted
           slice hosts come back by pod replacement (kubelet only restarts
-          containers in-place; a deleted/failed pod needs the controller).
+          containers in-place; a deleted/failed pod needs the controller)
+          — gated by ``allow_failure_restart`` (budget + rejoinability).
         """
         annotations = pod["metadata"].get("annotations") or {}
         stamp = annotations.get(constants.WORLD_SIZE_ANNOTATION)
@@ -624,7 +649,11 @@ class TPUJobController:
             return f"world size {stamp or 'unknown'} -> {replicas}"
         worker_spec = job.spec.replica_specs.get(REPLICA_TYPE_WORKER)
         restart_policy = worker_spec.restart_policy if worker_spec else ""
-        if restart_policy == RESTART_POLICY_ON_FAILURE and _pod_phase(pod) == POD_FAILED:
+        if (
+            allow_failure_restart
+            and restart_policy == RESTART_POLICY_ON_FAILURE
+            and _pod_phase(pod) == POD_FAILED
+        ):
             reason = (pod.get("status") or {}).get("reason", "")
             return f"failed{f' ({reason})' if reason else ''}"
         return None
@@ -696,11 +725,21 @@ class TPUJobController:
         worker_spec = job.spec.replica_specs.get(REPLICA_TYPE_WORKER)
         restart_policy = worker_spec.restart_policy if worker_spec else ""
         phases = [_pod_phase(p) for p in workers]
-        if (
-            restart_policy != RESTART_POLICY_ON_FAILURE
-            and any(p == POD_FAILED for p in phases)
-        ):
-            return True
+        if any(p == POD_FAILED for p in phases):
+            if restart_policy != RESTART_POLICY_ON_FAILURE:
+                return True
+            # OnFailure failures are terminal once the gang is no longer
+            # rejoinable (a Succeeded rank's process is gone forever) or
+            # the restart budget is spent.
+            if any(p == POD_SUCCEEDED for p in phases):
+                return True
+            backoff = job.spec.run_policy.backoff_limit
+            status = job.status.replica_statuses.get(REPLICA_TYPE_WORKER)
+            if backoff is not None and status and status.restarts >= backoff:
+                return True
+            return False
+        # len(workers) may exceed replicas (scale-down patched after the
+        # old gang already completed): all-Succeeded is done either way.
         return all(p == POD_SUCCEEDED for p in phases)
 
     def _update_job_status(
@@ -813,8 +852,10 @@ class TPUJobController:
                 mark_running()
             if (
                 replicas > 0
-                and succeeded == replicas
-                and len(workers) == replicas
+                # >= replicas: a scale-down patched after the old gang
+                # already completed must not block the Succeeded verdict.
+                and len(workers) >= replicas
+                and succeeded == len(workers)
                 and not st.is_succeeded(job.status)
             ):
                 msg = f"TPUJob {job.namespace}/{job.name} successfully completed."
@@ -826,16 +867,25 @@ class TPUJobController:
                 )
                 self.jobs_successful.inc()
             elif failed_pods and evicted == 0 and not st.is_finished(job.status):
+                backoff = job.spec.run_policy.backoff_limit
+                reason = st.TPUJOB_FAILED_REASON
+                detail = ""
+                if (
+                    backoff is not None
+                    and wstatus.restarts >= backoff
+                ):
+                    # BackoffLimitExceeded enrichment — the launcher-less
+                    # analog of :983-996.
+                    reason = JOB_BACKOFF_LIMIT_EXCEEDED_REASON
+                    detail = f" after {wstatus.restarts} restarts (backoffLimit {backoff})"
                 msg = truncate_message(
-                    f"TPUJob {job.namespace}/{job.name} has failed workers: "
+                    f"TPUJob {job.namespace}/{job.name} has failed workers{detail}: "
                     + ", ".join(sorted(failed_pods))
                 )
-                self.recorder.event(job, EVENT_TYPE_WARNING, st.TPUJOB_FAILED_REASON, msg)
+                self.recorder.event(job, EVENT_TYPE_WARNING, reason, msg)
                 if job.status.completion_time is None:
                     job.status.completion_time = now
-                st.update_job_conditions(
-                    job, JOB_FAILED, st.TPUJOB_FAILED_REASON, msg, now=now
-                )
+                st.update_job_conditions(job, JOB_FAILED, reason, msg, now=now)
                 self.jobs_failed.inc()
 
             # activeDeadlineSeconds has no launcher Job to enforce it here;

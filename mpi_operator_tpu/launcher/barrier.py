@@ -1,0 +1,220 @@
+"""Gang rendezvous barrier: coordinator-readiness gating.
+
+jax.distributed.initialize wedges when workers dial a coordinator that is
+not up yet (SURVEY.md §7 hard part 2); the reference absorbed the same
+race with sshd + ``ConnectionAttempts=10`` retry loops
+(/root/reference/v2/pkg/controller/mpi_job_controller.go:188-190). Our
+replacement is an explicit pre-rendezvous barrier: worker 0 serves,
+every rank (0 included) checks in, and nobody calls
+``jax.distributed.initialize`` until the whole gang is present.
+
+Two interchangeable engines, same wire protocol
+(``"TPUB" u32(rank)`` in, ``"GO!!"`` out):
+
+- **native**: ``native/barrier.cpp`` → ``libtpujob_barrier.so`` via
+  ctypes — poll-based C++, no Python threads on the serve path (built by
+  ``make -C native``);
+- **pure Python**: socket/threading fallback used automatically when the
+  shared library is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import logging
+import os
+import pathlib
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+MAGIC = b"TPUB"
+GO = b"GO!!"
+ENV_NATIVE_LIB = "TPUJOB_BARRIER_LIB"
+
+_REPO_NATIVE = pathlib.Path(__file__).resolve().parents[2] / "native"
+_SEARCH_PATHS = (
+    os.environ.get(ENV_NATIVE_LIB, ""),
+    str(_REPO_NATIVE / "libtpujob_barrier.so"),
+    "libtpujob_barrier.so",
+)
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    for path in _SEARCH_PATHS:
+        if not path:
+            continue
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            continue
+        lib.tpujob_barrier_serve.argtypes = [ctypes.c_int] * 3
+        lib.tpujob_barrier_serve.restype = ctypes.c_int
+        lib.tpujob_barrier_wait.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.tpujob_barrier_wait.restype = ctypes.c_int
+        return lib
+    return None
+
+
+_native = _load_native()
+
+
+def native_available() -> bool:
+    return _native is not None
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python engine (wire-compatible with barrier.cpp)
+# ---------------------------------------------------------------------------
+
+
+def _py_serve(port: int, world_size: int, timeout_ms: int) -> int:
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    # conn per rank; a re-check-in (client retry after a dropped connection)
+    # replaces the stale conn so the retrying rank still gets its GO.
+    conn_by_rank: dict[int, socket.socket] = {}
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as srv:
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(("0.0.0.0", port))
+            srv.listen(world_size + 8)
+            srv.settimeout(0.2)
+            while len(conn_by_rank) < world_size:
+                if time.monotonic() >= deadline:
+                    return -1
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                try:
+                    conn.settimeout(max(deadline - time.monotonic(), 0.01))
+                    hdr = b""
+                    while len(hdr) < 8:
+                        chunk = conn.recv(8 - len(hdr))
+                        if not chunk:
+                            break
+                        hdr += chunk
+                    if len(hdr) != 8 or hdr[:4] != MAGIC:
+                        conn.close()
+                        continue
+                    (rank,) = struct.unpack("<I", hdr[4:])
+                    if rank >= world_size:
+                        conn.close()
+                        continue
+                    old = conn_by_rank.pop(rank, None)
+                    if old is not None:
+                        old.close()
+                    conn_by_rank[rank] = conn
+                except OSError:
+                    conn.close()
+            for conn in conn_by_rank.values():
+                try:
+                    conn.sendall(GO)
+                except OSError:
+                    pass  # rank died post-check-in; jax.distributed will see it
+            return 0
+    except OSError:
+        return -1
+    finally:
+        for conn in conn_by_rank.values():
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def _py_wait(host: str, port: int, rank: int, timeout_ms: int) -> int:
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(
+                (host, port), timeout=max(deadline - time.monotonic(), 0.01)
+            ) as conn:
+                conn.sendall(MAGIC + struct.pack("<I", rank))
+                conn.settimeout(max(deadline - time.monotonic(), 0.01))
+                go = b""
+                while len(go) < 4:
+                    chunk = conn.recv(4 - len(go))
+                    if not chunk:
+                        break
+                    go += chunk
+                if go == GO:
+                    return 0
+        except OSError:
+            pass
+        time.sleep(0.2)
+    return -1
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def serve(port: int, world_size: int, timeout_s: float = 300.0) -> int:
+    """Serve one barrier round (blocking). 0 on success."""
+    timeout_ms = int(timeout_s * 1000)
+    if _native is not None:
+        return _native.tpujob_barrier_serve(port, world_size, timeout_ms)
+    return _py_serve(port, world_size, timeout_ms)
+
+
+def wait(host: str, port: int, rank: int, timeout_s: float = 300.0) -> int:
+    """Check in and block until the gang is complete. 0 on success."""
+    timeout_ms = int(timeout_s * 1000)
+    if _native is not None:
+        return _native.tpujob_barrier_wait(
+            host.encode(), port, rank, timeout_ms
+        )
+    return _py_wait(host, port, rank, timeout_ms)
+
+
+def gang_barrier(
+    *,
+    coordinator_host: str,
+    port: int,
+    rank: int,
+    world_size: int,
+    timeout_s: float = 300.0,
+) -> None:
+    """Full gang readiness barrier: rank 0 serves (in a thread) and also
+    checks in; everyone returns only when all ranks arrived.
+
+    Raises TimeoutError if the gang does not assemble in time.
+    """
+    engine = "native" if _native is not None else "python"
+    server: Optional[threading.Thread] = None
+    serve_rc: list[int] = [0]
+    if rank == 0:
+        def _run():
+            serve_rc[0] = serve(port, world_size, timeout_s)
+
+        server = threading.Thread(target=_run, daemon=True, name="tpujob-barrier")
+        server.start()
+        host = "127.0.0.1"  # rank 0 dials its own server locally
+    else:
+        host = coordinator_host
+
+    log.info(
+        "gang barrier (%s): rank %d/%d via %s:%d", engine, rank, world_size,
+        host, port,
+    )
+    rc = wait(host, port, rank, timeout_s)
+    if server is not None:
+        server.join(timeout=timeout_s)
+        if serve_rc[0] != 0:
+            raise TimeoutError(
+                f"barrier server failed (rc={serve_rc[0]}): "
+                f"{world_size - 1} peer(s) missing"
+            )
+    if rc != 0:
+        raise TimeoutError(
+            f"rank {rank} gang barrier timed out after {timeout_s:.0f}s (rc={rc})"
+        )

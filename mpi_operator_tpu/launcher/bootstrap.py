@@ -79,11 +79,16 @@ def initialize(
     config: Optional[RendezvousConfig] = None,
     *,
     initialization_timeout_seconds: int = 300,
+    readiness_barrier: bool = True,
 ) -> RendezvousConfig:
     """Join the job's jax.distributed world (idempotent).
 
     Single-process jobs (num_processes == 1) skip distributed init
     entirely, so the same worker image runs unchanged on one host.
+
+    ``readiness_barrier`` first assembles the gang on a side port
+    (coordinator port + 1) so no rank dials jax.distributed before the
+    coordinator process exists — the SSH-retry analog (launcher.barrier).
     """
     global _initialized
     cfg = config or RendezvousConfig.from_env()
@@ -92,6 +97,18 @@ def initialize(
         return cfg
     if _initialized:
         return cfg
+
+    if readiness_barrier and cfg.coordinator_address:
+        from . import barrier
+
+        host, _, port_str = cfg.coordinator_address.partition(":")
+        barrier.gang_barrier(
+            coordinator_host=host,
+            port=int(port_str or constants.DEFAULT_COORDINATOR_PORT) + 1,
+            rank=cfg.process_id,
+            world_size=cfg.num_processes,
+            timeout_s=initialization_timeout_seconds,
+        )
 
     import jax
 

@@ -1,0 +1,221 @@
+// TPUJob gang rendezvous barrier — native runtime component.
+//
+// Why this exists: jax.distributed.initialize is unforgiving about start
+// order — a worker that dials a not-yet-listening coordinator burns its
+// connection budget and the whole gang wedges. The reference absorbed
+// this with SSH retry loops (ConnectionAttempts=10 in
+// /root/reference/v2/pkg/controller/mpi_job_controller.go:188-190 and the
+// sshd bootstrap in build/base/); our TPU-native equivalent is an
+// explicit, cheap readiness barrier that runs BEFORE
+// jax.distributed.initialize: worker 0 serves, everyone (0 included)
+// waits, and only when all N ranks have checked in does anyone proceed to
+// the real rendezvous.
+//
+// Exposed as a tiny C ABI consumed from Python via ctypes
+// (mpi_operator_tpu/launcher/barrier.py), which also carries a
+// wire-compatible pure-Python fallback for environments without the
+// shared library. Wire protocol (all little-endian):
+//   client -> server: "TPUB" u32(rank)
+//   server -> client: "GO!!"           (after all world_size ranks arrive)
+//
+// Build: make -C native   (produces libtpujob_barrier.so)
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[4] = {'T', 'P', 'U', 'B'};
+constexpr char kGo[4] = {'G', 'O', '!', '!'};
+
+int64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Read/write exactly n bytes with a deadline; 0 on success.
+int io_exact(int fd, void* buf, size_t n, bool write_mode, int64_t deadline) {
+  auto* p = static_cast<char*>(buf);
+  size_t done = 0;
+  while (done < n) {
+    int64_t left = deadline - now_ms();
+    if (left <= 0) return -ETIMEDOUT;
+    struct pollfd pfd = {fd, static_cast<short>(write_mode ? POLLOUT : POLLIN), 0};
+    int pr = poll(&pfd, 1, static_cast<int>(left));
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    if (pr == 0) return -ETIMEDOUT;
+    ssize_t r = write_mode ? write(fd, p + done, n - done)
+                           : read(fd, p + done, n - done);
+    if (r < 0) {
+      if (errno == EINTR || errno == EAGAIN) continue;
+      return -errno;
+    }
+    if (r == 0) return -ECONNRESET;
+    done += static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+void set_nonblock(int fd) { fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK); }
+
+}  // namespace
+
+extern "C" {
+
+// Serve one barrier round: accept connections until `world_size` distinct
+// ranks have checked in, then release them all. Returns 0 on success,
+// -ETIMEDOUT / -errno on failure. Binds 0.0.0.0:port.
+int tpujob_barrier_serve(int port, int world_size, int timeout_ms) {
+  if (world_size <= 0 || world_size > 1 << 20) return -EINVAL;
+  int64_t deadline = now_ms() + timeout_ms;
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  if (srv < 0) return -errno;
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(srv, world_size + 8) < 0) {
+    int err = -errno;
+    close(srv);
+    return err;
+  }
+  set_nonblock(srv);
+
+  // fd per rank; a re-check-in (client retry after a dropped connection)
+  // replaces the stale fd so the retrying rank still gets its GO.
+  std::vector<int> fd_by_rank(world_size, -1);
+  int arrived = 0;
+  int rc = 0;
+
+  while (arrived < world_size) {
+    int64_t left = deadline - now_ms();
+    if (left <= 0) {
+      rc = -ETIMEDOUT;
+      break;
+    }
+    struct pollfd pfd = {srv, POLLIN, 0};
+    int pr = poll(&pfd, 1, static_cast<int>(left));
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      rc = -errno;
+      break;
+    }
+    if (pr == 0) {
+      rc = -ETIMEDOUT;
+      break;
+    }
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EAGAIN || errno == EINTR) continue;
+      rc = -errno;
+      break;
+    }
+    char hdr[8];
+    if (io_exact(fd, hdr, sizeof(hdr), /*write=*/false, deadline) != 0 ||
+        memcmp(hdr, kMagic, 4) != 0) {
+      close(fd);  // stray/garbled connection (health probe?): ignore
+      continue;
+    }
+    uint32_t rank;
+    memcpy(&rank, hdr + 4, 4);
+    if (rank >= static_cast<uint32_t>(world_size)) {
+      close(fd);  // out-of-range: drop quietly
+      continue;
+    }
+    if (fd_by_rank[rank] >= 0) {
+      close(fd_by_rank[rank]);  // retry supersedes the stale connection
+    } else {
+      ++arrived;
+    }
+    fd_by_rank[rank] = fd;
+  }
+
+  if (rc == 0) {
+    for (int fd : fd_by_rank) {
+      // Best-effort release; a rank that dies between check-in and GO will
+      // surface in jax.distributed.initialize immediately after anyway.
+      if (fd >= 0) io_exact(fd, const_cast<char*>(kGo), 4, /*write=*/true, deadline);
+    }
+  }
+  for (int fd : fd_by_rank) {
+    if (fd >= 0) close(fd);
+  }
+  close(srv);
+  return rc;
+}
+
+// Check in at the barrier and block until released. Retries the connect
+// until the server exists (the coordinator pod may still be starting —
+// this loop is the SSH-retry analog). Returns 0 on success.
+int tpujob_barrier_wait(const char* host, int port, int rank, int timeout_ms) {
+  int64_t deadline = now_ms() + timeout_ms;
+  char port_str[16];
+  snprintf(port_str, sizeof(port_str), "%d", port);
+
+  while (true) {
+    if (now_ms() >= deadline) return -ETIMEDOUT;
+
+    addrinfo hints{};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    // DNS for the coordinator's headless-Service name may itself lag pod
+    // creation; resolution failures are retried like refused connects.
+    if (getaddrinfo(host, port_str, &hints, &res) != 0 || res == nullptr) {
+      usleep(200 * 1000);
+      continue;
+    }
+    int fd = -1;
+    for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+      fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd < 0) continue;
+      if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+      close(fd);
+      fd = -1;
+    }
+    freeaddrinfo(res);
+    if (fd < 0) {
+      usleep(200 * 1000);
+      continue;
+    }
+
+    char hdr[8];
+    memcpy(hdr, kMagic, 4);
+    uint32_t r = static_cast<uint32_t>(rank);
+    memcpy(hdr + 4, &r, 4);
+    char go[4];
+    if (io_exact(fd, hdr, sizeof(hdr), /*write=*/true, deadline) == 0 &&
+        io_exact(fd, go, sizeof(go), /*write=*/false, deadline) == 0 &&
+        memcmp(go, kGo, 4) == 0) {
+      close(fd);
+      return 0;
+    }
+    close(fd);
+    // Server may have restarted mid-round; re-check-in until deadline.
+    usleep(200 * 1000);
+  }
+}
+
+}  // extern "C"

@@ -197,11 +197,13 @@ class V2beta1ReplicaStatus(_Model):
         "active": int,
         "succeeded": int,
         "failed": int,
+        "restarts": int,
     }
     attribute_map = {
         "active": "active",
         "succeeded": "succeeded",
         "failed": "failed",
+        "restarts": "restarts",
     }
 
 

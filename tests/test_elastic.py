@@ -153,6 +153,63 @@ class TestFailedWorkerRejoin:
         assert st.has_condition(status, JOB_RESTARTING)
         assert not st.is_failed(status)  # eviction did not kill the job
 
+    def test_backoff_limit_bounds_replacements(self):
+        # A crash-looping worker is replaced at most backoffLimit times,
+        # then the job fails terminally with BackoffLimitExceeded.
+        f = Fixture()
+        job = f.new_job(workers=4, backoff_limit=2)
+        job.spec.replica_specs[REPLICA_TYPE_WORKER].restart_policy = "OnFailure"
+        f.start()
+        created = f.create_job(job)
+        f.sync(created)
+        for _ in range(2):  # two budgeted replacements
+            f.set_pod_phase("test-job-worker-0", "Failed")
+            f.sync(created)
+            assert not st.is_failed(f.get_job().status)
+        assert f.get_job().status.replica_statuses[REPLICA_TYPE_WORKER].restarts == 2
+        f.set_pod_phase("test-job-worker-0", "Failed")  # budget spent
+        f.sync(created)
+        status = f.get_job().status
+        assert st.is_failed(status)
+        cond = st.get_condition(status, JOB_FAILED)
+        assert cond.reason == "BackoffLimitExceeded"
+
+    def test_no_rejoin_after_sibling_succeeded(self):
+        # Once any rank exited Succeeded the gang cannot be re-formed; a
+        # late failure is terminal even under OnFailure.
+        f = Fixture()
+        job = f.new_job(workers=4)
+        job.spec.replica_specs[REPLICA_TYPE_WORKER].restart_policy = "OnFailure"
+        f.start()
+        created = f.create_job(job)
+        f.sync(created)
+        for i in range(3):
+            f.set_pod_phase(f"test-job-worker-{i}", "Succeeded")
+        f.set_pod_phase("test-job-worker-3", "Failed", reason="Evicted")
+        uid_before = f.api.get("pods", "default", "test-job-worker-3")["metadata"]["uid"]
+        f.sync(created)
+        # Not replaced, and the job is terminally failed.
+        after = f.api.get("pods", "default", "test-job-worker-3")
+        assert after["metadata"]["uid"] == uid_before
+        assert st.is_failed(f.get_job().status)
+
+    def test_scale_down_after_completion_still_succeeds(self):
+        # All 8 workers Succeeded, then the user patches replicas to 4:
+        # the completed gang must still be declared Succeeded, not wedge.
+        f = Fixture()
+        job = f.new_job(workers=8)
+        job.spec.tpu.num_slices = 2
+        f.start()
+        created = f.create_job(job)
+        f.sync(created)
+        f.set_all_workers_phase(created, "Succeeded")
+        live = f.get_job()
+        live.spec.tpu.num_slices = 1
+        live.spec.replica_specs[REPLICA_TYPE_WORKER].replicas = 4
+        f.controller.tpujobs.tpujobs("default").update(live)
+        f.sync(live)
+        assert st.is_succeeded(f.get_job().status)
+
     def test_never_policy_fails_job_on_eviction(self):
         f = Fixture()
         job = make_synced_job(f)  # default restartPolicy Never
