@@ -27,7 +27,6 @@ import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .. import __version__
 from ..api.v2beta1 import constants
 from ..controller import status as st
 from ..controller.tpu_job_controller import TPUJobController
@@ -35,6 +34,7 @@ from ..runtime.apiserver import InMemoryAPIServer, NotFoundError
 from ..runtime.leaderelection import LeaderElectionConfig, LeaderElector
 from ..runtime.podrunner import LocalPodRunner
 from ..utils import metrics
+from ..version import version_string
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -66,8 +66,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="TPUJob YAML file(s) to apply at startup")
     p.add_argument("--exit-on-completion", action="store_true",
                    help="exit once every applied TPUJob is finished")
-    p.add_argument("--version", action="version",
-                   version=f"tpu-operator {__version__}")
+    p.add_argument("--version", action="version", version=version_string())
     return p
 
 

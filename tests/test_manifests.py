@@ -230,3 +230,27 @@ def test_helm_chart_structure():
     # The CRD ships in crds/ ONLY — a templated copy would make helm
     # conflict with its own crds/ install.
     assert "tpujob-crd.yaml" not in templates
+
+
+def test_runtime_base_image_is_tpu_native():
+    """Inventory #17 analog (build/base): worker base image must carry no
+    SSH machinery and no GPU/NCCL residue — rendezvous is jax.distributed
+    plus the gang barrier."""
+    text = (ROOT / "build" / "base" / "Dockerfile").read_text()
+    lower = text.lower()
+    for token in ("openssh", "sshd", "nvidia", "nccl"):
+        # Words may appear in comments explaining the delta; forbid them in
+        # actual instructions.
+        for line in lower.splitlines():
+            stripped = line.strip()
+            if stripped.startswith("#"):
+                continue
+            assert token not in stripped, f"{token!r} leaked into: {line!r}"
+    assert "jax[tpu]" in text
+    assert "healthcheck" in text
+
+
+def test_pi_example_image_builds_from_base():
+    text = (ROOT / "examples" / "v2beta1" / "pi" / "Dockerfile").read_text()
+    assert "FROM tpu-job-operator/base" in text
+    assert "pi.py" in text

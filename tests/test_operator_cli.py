@@ -59,3 +59,47 @@ spec:
         out = run_cli("--apply", str(bad), "--exit-on-completion")
         assert out.returncode == 1
         assert "Failed" in out.stdout
+
+
+class TestVersionStamp:
+    def test_version_string_fallback(self):
+        from mpi_operator_tpu import version
+
+        s = version.version_string()
+        assert s.startswith("tpu-operator ")
+        assert "git" in s and "built" in s
+
+    def test_stamp_script_generates_build_info(self, tmp_path, monkeypatch):
+        import pathlib
+        import subprocess
+        import sys
+
+        root = pathlib.Path(__file__).resolve().parent.parent
+        out = root / "mpi_operator_tpu" / "_build_info.py"
+        prior = out.read_text() if out.exists() else None
+        try:
+            rc = subprocess.run(
+                [sys.executable, str(root / "hack" / "stamp_version.py"),
+                 "--version", "9.9.9-test", "--git-sha", "cafe123"],
+                capture_output=True, text=True,
+            )
+            assert rc.returncode == 0, rc.stderr
+            text = out.read_text()
+            assert "VERSION = '9.9.9-test'" in text
+            assert "GIT_SHA = 'cafe123'" in text and "BUILT" in text
+        finally:
+            # Restore whatever stamp existed before; never leave test residue.
+            if prior is None:
+                out.unlink(missing_ok=True)
+            else:
+                out.write_text(prior)
+
+    def test_cli_version_flag(self, capsys):
+        import pytest
+
+        from mpi_operator_tpu.cmd import operator as op
+
+        with pytest.raises(SystemExit) as exc:
+            op.build_parser().parse_args(["--version"])
+        assert exc.value.code == 0
+        assert "tpu-operator" in capsys.readouterr().out
