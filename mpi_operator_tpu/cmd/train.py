@@ -1,0 +1,324 @@
+"""Generic training entrypoint — the workload every example TPUJob runs.
+
+The reference's examples each carry their own training script inside the
+user image (tf_cnn_benchmarks, Horovod MNIST, …); our framework ships
+one SPMD trainer that covers the BASELINE.md milestone families:
+
+    python -m mpi_operator_tpu.cmd.train --model resnet101 --steps 200
+    python -m mpi_operator_tpu.cmd.train --model bert-base --mesh dp=-1
+    python -m mpi_operator_tpu.cmd.train --model llama3-8b \
+        --mesh dp=2,fsdp=8,tp=4 --seq-len 4096 --checkpoint-dir gs://...
+
+Flow: rendezvous (launcher.bootstrap: gang barrier +
+jax.distributed.initialize, driven by the env the controller injected) →
+mesh → model + shardings → orbax resume → jit train loop with step-time
+logging and optional XLA profiler trace (SURVEY.md §5 aux subsystems) →
+checkpoints → one JSON metrics line on stdout.
+
+Synthetic data throughout (the reference's headline bench is synthetic
+ImageNet too, README.md:175-206); a real input pipeline plugs in at
+``make_batch``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+from typing import Any, Callable, Optional
+
+log = logging.getLogger("tpujob.train")
+
+
+def parse_mesh_spec(spec: str) -> dict[str, int]:
+    """'dp=2,fsdp=4,tp=2' -> {'dp': 2, 'fsdp': 4, 'tp': 2}; '' -> dp=-1."""
+    if not spec:
+        return {"dp": -1}
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        if not size:
+            raise ValueError(f"bad mesh axis {part!r}; want name=size")
+        out[name.strip()] = int(size)
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpujob-train", description="SPMD trainer for TPUJob workloads"
+    )
+    p.add_argument("--model", default="resnet101",
+                   help="resnet18|resnet50|resnet101|bert-base|bert-tiny|"
+                        "llama3-8b|llama-tiny")
+    p.add_argument("--mesh", default="", help="axis spec, e.g. dp=2,fsdp=4,tp=2")
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--global-batch", type=int, default=0,
+                   help="0 = pick per model (resnet: 64/chip; lm: 8/chip)")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--seq-len", type=int, default=512)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--checkpoint-dir", default="")
+    p.add_argument("--save-every", type=int, default=100)
+    p.add_argument("--profile-dir", default="",
+                   help="write an XLA profiler trace of steps 10-12 here")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+class Workload:
+    """A model family adapted to the trainer loop."""
+
+    def __init__(self, *, state: dict, step_fn: Callable, batch: tuple,
+                 examples_per_step: int, mesh):
+        self.state = state
+        self.step_fn = step_fn
+        self.batch = batch
+        self.examples_per_step = examples_per_step
+        self.mesh = mesh
+
+
+def _resnet_workload(args, mesh, n_devices: int) -> Workload:
+    import jax
+    import numpy as np
+    import optax
+
+    from ..models import resnet as resnet_lib
+    from ..parallel import shard_batch, shard_params
+
+    depth = int(args.model.removeprefix("resnet"))
+    global_batch = args.global_batch or 64 * n_devices
+    model = resnet_lib.resnet(depth)
+    params, batch_stats = resnet_lib.create_train_state(
+        model, jax.random.PRNGKey(args.seed), image_size=args.image_size
+    )
+    optimizer = optax.sgd(args.lr, momentum=0.9, nesterov=True)
+    opt_state = optimizer.init(params)
+    params = shard_params(params, mesh)
+    batch_stats = shard_params(batch_stats, mesh)
+    opt_state = shard_params(opt_state, mesh)
+
+    rng = np.random.RandomState(args.seed)
+    images = shard_batch(
+        rng.standard_normal(
+            (global_batch, args.image_size, args.image_size, 3)
+        ).astype(np.float32),
+        mesh,
+    )
+    labels = shard_batch(rng.randint(0, 1000, (global_batch,)), mesh)
+
+    raw_step = jax.jit(
+        resnet_lib.make_train_step(model, optimizer), donate_argnums=(0, 1, 2)
+    )
+
+    def step_fn(state, batch):
+        params, batch_stats, opt_state, loss = raw_step(
+            state["params"], state["batch_stats"], state["opt_state"], *batch
+        )
+        return {
+            "params": params, "batch_stats": batch_stats, "opt_state": opt_state,
+        }, loss
+
+    return Workload(
+        state={"params": params, "batch_stats": batch_stats, "opt_state": opt_state},
+        step_fn=step_fn,
+        batch=(images, labels),
+        examples_per_step=global_batch,
+        mesh=mesh,
+    )
+
+
+def _lm_workload(args, mesh, n_devices: int) -> Workload:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ..parallel import shard_batch, shard_params
+    from ..parallel.mesh import SP
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    sp = sizes.get(SP, 1)
+    global_batch = args.global_batch or 8 * max(n_devices // sp, 1)
+    rng = np.random.RandomState(args.seed)
+
+    if args.model.startswith("bert"):
+        from ..models import bert as lib
+
+        cfg = lib.bert_base() if args.model == "bert-base" else lib.tiny()
+        model = lib.Bert(cfg)
+        params = lib.init_params(model, jax.random.PRNGKey(args.seed))
+        rules = lib.param_sharding_rules(mesh)
+        optimizer = optax.adamw(args.lr)
+        targets = shard_batch(
+            jnp.asarray(
+                rng.randint(0, cfg.vocab_size, (global_batch, args.seq_len)),
+                jnp.int32,
+            ),
+            mesh,
+        )
+        mask = shard_batch(
+            jnp.asarray(rng.rand(global_batch, args.seq_len) < 0.15, jnp.float32),
+            mesh,
+        )
+        tokens = jnp.where(mask.astype(bool), 0, targets)
+        batch = (tokens, mask, targets)
+        raw = jax.jit(lib.make_train_step(model, optimizer), donate_argnums=(0, 1))
+        examples = global_batch
+    else:
+        from ..models import llama as lib
+
+        attention = "ring" if sp > 1 else "flash"
+        if args.model == "llama3-8b":
+            cfg = lib.llama3_8b(attention_impl=attention)
+        else:
+            cfg = lib.tiny(attention_impl=attention)
+        model = lib.Llama(cfg, mesh=mesh)
+        with mesh:
+            params = lib.init_params(
+                model, jax.random.PRNGKey(args.seed),
+                batch=2, seq=max(16, sp * 16),
+            )
+        rules = lib.param_sharding_rules(mesh)
+        optimizer = optax.adamw(args.lr)
+        tokens = shard_batch(
+            jnp.asarray(
+                rng.randint(0, cfg.vocab_size, (global_batch, args.seq_len)),
+                jnp.int32,
+            ),
+            mesh,
+            sequence_axis=1 if sp > 1 else None,
+        )
+        batch = (tokens,)
+        raw = jax.jit(lib.make_train_step(model, optimizer), donate_argnums=(0, 1))
+        examples = global_batch
+
+    params = shard_params(params, mesh, rules=rules)
+    opt_state = shard_params(optimizer.init(params), mesh, rules=rules)
+
+    def step_fn(state, batch):
+        params, opt_state, loss = raw(state["params"], state["opt_state"], *batch)
+        return {"params": params, "opt_state": opt_state}, loss
+
+    return Workload(
+        state={"params": params, "opt_state": opt_state},
+        step_fn=step_fn,
+        batch=batch,
+        examples_per_step=examples,
+        mesh=mesh,
+    )
+
+
+def build_workload(args, mesh, n_devices: int) -> Workload:
+    if args.model.startswith("resnet"):
+        return _resnet_workload(args, mesh, n_devices)
+    if args.model.startswith(("bert", "llama")):
+        return _lm_workload(args, mesh, n_devices)
+    raise SystemExit(f"unknown --model {args.model!r}")
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
+    args = build_parser().parse_args(argv)
+    if args.steps < 1:
+        raise SystemExit("--steps must be >= 1")
+
+    from ..launcher import bootstrap
+    from ..parallel import create_mesh
+
+    cfg = bootstrap.initialize()
+
+    import jax
+
+    devices = jax.devices()
+    mesh = create_mesh(**parse_mesh_spec(args.mesh))
+    log.info(
+        "process %d/%d, %d devices, mesh %s",
+        cfg.process_id, cfg.num_processes, len(devices),
+        dict(zip(mesh.axis_names, mesh.devices.shape)),
+    )
+
+    work = build_workload(args, mesh, len(devices))
+
+    ckpt = None
+    start_step = 0
+    if args.checkpoint_dir:
+        from ..utils.checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(
+            args.checkpoint_dir,
+            save_interval_steps=args.save_every,
+        )
+        resumed, state = ckpt.restore_latest(work.state)
+        if resumed is not None:
+            work.state, start_step = state, resumed
+            log.info("resumed at step %d", start_step)
+
+    # Warmup steps are real optimizer steps and count toward the step
+    # number (anything else would desync the checkpoint step from the
+    # optimization state on every elastic restart); only the timing
+    # excludes them, so compile cost stays out of the throughput number.
+    warmup = max(args.warmup, 1)
+    tracing = False
+    with work.mesh:
+        t0 = t_log = None
+        step = start_step
+        end = start_step + warmup + args.steps
+        while step < end:
+            if step == start_step + warmup:
+                jax.block_until_ready(work.state)
+                t0 = t_log = time.perf_counter()
+            if args.profile_dir and step == start_step + warmup + 10:
+                jax.profiler.start_trace(args.profile_dir)
+                tracing = True
+            work.state, loss = work.step_fn(work.state, work.batch)
+            step += 1
+            if tracing and step == start_step + warmup + 13:
+                jax.block_until_ready(loss)
+                jax.profiler.stop_trace()
+                tracing = False
+                log.info("profiler trace written to %s", args.profile_dir)
+            if args.log_every and step % args.log_every == 0:
+                jax.block_until_ready(loss)
+                now = time.perf_counter()
+                ms = (now - (t_log or now)) / args.log_every * 1000
+                log.info("step %d: loss=%.4f %.1f ms/step", step, float(loss), ms)
+                t_log = now
+            if ckpt is not None:
+                ckpt.save(step, work.state)
+        jax.block_until_ready(loss)
+        if tracing:  # run ended inside the trace window
+            jax.profiler.stop_trace()
+            log.info("profiler trace written to %s", args.profile_dir)
+        elapsed = time.perf_counter() - t0
+        final_loss = float(loss)
+
+    if ckpt is not None:
+        ckpt.save(step, work.state, force=True)
+        ckpt.wait_until_finished()
+        ckpt.close()
+
+    examples_per_sec = work.examples_per_step * args.steps / elapsed
+    print(
+        json.dumps(
+            {
+                "model": args.model,
+                "steps": args.steps,
+                "final_step": step,
+                "loss": final_loss,
+                "examples_per_sec": round(examples_per_sec, 2),
+                "step_ms": round(elapsed / args.steps * 1000, 2),
+                "devices": len(devices),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,82 @@
+"""Checkpoint/resume for elastic TPU training (orbax wrapper).
+
+The reference operator deliberately owns no checkpointing — it guarantees
+restart/rejoin and leaves state to user code (SURVEY.md §5,
+proposals/elastic-horovod.md premise). Our framework keeps that
+separation but ships the workload-side half: a thin orbax
+CheckpointManager wrapper the trainer (cmd/train.py) uses so a gang that
+was elastically restarted (launcher.barrier + the controller's
+world-size restamping) resumes from the last step instead of step 0.
+
+Orbax is multi-host aware: every process must call save/restore
+collectively; only process 0 writes metadata. Sharded jax.Arrays are
+saved/restored with their shardings, so a resume onto a *different* mesh
+shape (elastic resize!) works by passing ``restore_args`` built from the
+new mesh — see ``restore_latest(..., like=state)``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+
+class CheckpointManager:
+    """save-every-N / keep-K / resume-latest, orbax-backed."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        save_interval_steps: int = 100,
+        max_to_keep: int = 3,
+    ):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = directory
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                save_interval_steps=save_interval_steps,
+                max_to_keep=max_to_keep,
+                create=True,
+            ),
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Save if the interval policy says so (or ``force``). A step that
+        already exists is never re-saved (orbax raises on overwrite)."""
+        if step in (self._mgr.all_steps() or ()):
+            return False
+        saved = self._mgr.save(
+            step, args=self._ocp.args.StandardSave(state), force=force
+        )
+        if saved:
+            log.info("checkpoint saved at step %d -> %s", step, self.directory)
+        return saved
+
+    def restore_latest(self, like: Any) -> tuple[Optional[int], Any]:
+        """Restore the newest checkpoint shaped/sharded like ``like``
+        (the freshly-initialized state on the *current* mesh — this is
+        what makes resume-after-elastic-resize work). Returns
+        ``(step, state)`` or ``(None, like)`` when no checkpoint exists."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None, like
+        state = self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore(like)
+        )
+        log.info("resumed from checkpoint step %d (%s)", step, self.directory)
+        return step, state
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
