@@ -53,7 +53,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resnet18|resnet50|resnet101|bert-base|bert-tiny|"
                         "llama3-8b|llama-tiny")
     p.add_argument("--mesh", default="", help="axis spec, e.g. dp=2,fsdp=4,tp=2")
-    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--steps", type=int, default=100,
+                   help="ABSOLUTE target step: a resumed run trains only the "
+                        "remainder from the latest checkpoint")
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--global-batch", type=int, default=0,
@@ -145,14 +147,13 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
     global_batch = args.global_batch or 8 * max(n_devices // sp, 1)
     rng = np.random.RandomState(args.seed)
 
+    optimizer = optax.adamw(args.lr)
     if args.model.startswith("bert"):
         from ..models import bert as lib
 
         cfg = lib.bert_base() if args.model == "bert-base" else lib.tiny()
         model = lib.Bert(cfg)
         params = lib.init_params(model, jax.random.PRNGKey(args.seed))
-        rules = lib.param_sharding_rules(mesh)
-        optimizer = optax.adamw(args.lr)
         targets = shard_batch(
             jnp.asarray(
                 rng.randint(0, cfg.vocab_size, (global_batch, args.seq_len)),
@@ -166,8 +167,6 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
         )
         tokens = jnp.where(mask.astype(bool), 0, targets)
         batch = (tokens, mask, targets)
-        raw = jax.jit(lib.make_train_step(model, optimizer), donate_argnums=(0, 1))
-        examples = global_batch
     else:
         from ..models import llama as lib
 
@@ -182,8 +181,6 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
                 model, jax.random.PRNGKey(args.seed),
                 batch=2, seq=max(16, sp * 16),
             )
-        rules = lib.param_sharding_rules(mesh)
-        optimizer = optax.adamw(args.lr)
         tokens = shard_batch(
             jnp.asarray(
                 rng.randint(0, cfg.vocab_size, (global_batch, args.seq_len)),
@@ -193,21 +190,23 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
             sequence_axis=1 if sp > 1 else None,
         )
         batch = (tokens,)
-        raw = jax.jit(lib.make_train_step(model, optimizer), donate_argnums=(0, 1))
-        examples = global_batch
 
+    rules = lib.param_sharding_rules(mesh)
     params = shard_params(params, mesh, rules=rules)
     opt_state = shard_params(optimizer.init(params), mesh, rules=rules)
+    raw_step = jax.jit(lib.make_train_step(model, optimizer), donate_argnums=(0, 1))
 
     def step_fn(state, batch):
-        params, opt_state, loss = raw(state["params"], state["opt_state"], *batch)
+        params, opt_state, loss = raw_step(
+            state["params"], state["opt_state"], *batch
+        )
         return {"params": params, "opt_state": opt_state}, loss
 
     return Workload(
         state={"params": params, "opt_state": opt_state},
         step_fn=step_fn,
         batch=batch,
-        examples_per_step=examples,
+        examples_per_step=global_batch,
         mesh=mesh,
     )
 
@@ -259,36 +258,57 @@ def main(argv=None) -> int:
             work.state, start_step = state, resumed
             log.info("resumed at step %d", start_step)
 
-    # Warmup steps are real optimizer steps and count toward the step
-    # number (anything else would desync the checkpoint step from the
-    # optimization state on every elastic restart); only the timing
-    # excludes them, so compile cost stays out of the throughput number.
+    # --steps is an ABSOLUTE target: a restarted gang resumes at the
+    # checkpoint step and runs only the remainder, so preemptions never
+    # extend the job (SURVEY.md §3.4 rejoin semantics). Warmup steps are
+    # real optimizer steps and count toward the step number (anything
+    # else would desync the checkpoint step from the optimization state
+    # on every restart); only the timing excludes them, so compile cost
+    # stays out of the throughput number.
+    end = args.steps
+    if start_step >= end:
+        log.info("checkpoint already at step %d >= --steps %d; nothing to do",
+                 start_step, end)
+        if ckpt is not None:
+            ckpt.close()
+        print(json.dumps({
+            "model": args.model, "steps": 0, "final_step": start_step,
+            "loss": None, "examples_per_sec": 0.0, "step_ms": 0.0,
+            "devices": len(devices),
+        }))
+        return 0
     warmup = max(args.warmup, 1)
+    # Always leave >= 1 timed step even on a short resume tail.
+    timed_from = min(start_step + warmup, end - 1)
     tracing = False
     with work.mesh:
         t0 = t_log = None
-        step = start_step
-        end = start_step + warmup + args.steps
+        step = last_log_step = start_step
         while step < end:
-            if step == start_step + warmup:
+            if step == timed_from:
                 jax.block_until_ready(work.state)
                 t0 = t_log = time.perf_counter()
-            if args.profile_dir and step == start_step + warmup + 10:
+                last_log_step = step
+            if args.profile_dir and step == timed_from + 10:
                 jax.profiler.start_trace(args.profile_dir)
                 tracing = True
             work.state, loss = work.step_fn(work.state, work.batch)
             step += 1
-            if tracing and step == start_step + warmup + 13:
+            if tracing and step == timed_from + 13:
                 jax.block_until_ready(loss)
                 jax.profiler.stop_trace()
                 tracing = False
                 log.info("profiler trace written to %s", args.profile_dir)
             if args.log_every and step % args.log_every == 0:
                 jax.block_until_ready(loss)
-                now = time.perf_counter()
-                ms = (now - (t_log or now)) / args.log_every * 1000
-                log.info("step %d: loss=%.4f %.1f ms/step", step, float(loss), ms)
-                t_log = now
+                if t_log is not None and step > last_log_step:
+                    now = time.perf_counter()
+                    ms = (now - t_log) / (step - last_log_step) * 1000
+                    log.info("step %d: loss=%.4f %.1f ms/step",
+                             step, float(loss), ms)
+                    t_log, last_log_step = now, step
+                else:  # still inside warmup: loss only, no bogus timing
+                    log.info("step %d: loss=%.4f (warmup)", step, float(loss))
             if ckpt is not None:
                 ckpt.save(step, work.state)
         jax.block_until_ready(loss)
@@ -296,6 +316,7 @@ def main(argv=None) -> int:
             jax.profiler.stop_trace()
             log.info("profiler trace written to %s", args.profile_dir)
         elapsed = time.perf_counter() - t0
+        timed_steps = end - timed_from
         final_loss = float(loss)
 
     if ckpt is not None:
@@ -303,16 +324,16 @@ def main(argv=None) -> int:
         ckpt.wait_until_finished()
         ckpt.close()
 
-    examples_per_sec = work.examples_per_step * args.steps / elapsed
+    examples_per_sec = work.examples_per_step * timed_steps / elapsed
     print(
         json.dumps(
             {
                 "model": args.model,
-                "steps": args.steps,
+                "steps": step - start_step,
                 "final_step": step,
                 "loss": final_loss,
                 "examples_per_sec": round(examples_per_sec, 2),
-                "step_ms": round(elapsed / args.steps * 1000, 2),
+                "step_ms": round(elapsed / timed_steps * 1000, 2),
                 "devices": len(devices),
             }
         )

@@ -1,0 +1,266 @@
+"""Integration tier — the envtest analog (SURVEY.md §4.2).
+
+The reference boots a real apiserver+etcd with no kubelet and runs the
+real controller against it, driving pod/job phases by hand and checking
+expected Events in order (v2/test/integration/main_test.go:42-178). Here
+the in-memory apiserver plays apiserver+etcd, the controller runs its
+REAL ``run()`` loop — informer pump thread + worker threads + rate
+limited workqueue, no synchronous sync_pending() shortcuts — and an
+event checker asserts the user-visible audit trail arrives in order.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from mpi_operator_tpu.api.v2beta1 import constants
+from mpi_operator_tpu.api.v2beta1.types import (
+    REPLICA_TYPE_LAUNCHER,
+    REPLICA_TYPE_WORKER,
+    ReplicaSpec,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+)
+from mpi_operator_tpu.controller import builders
+from mpi_operator_tpu.controller import status as st
+from mpi_operator_tpu.controller.tpu_job_controller import TPUJobController
+from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer
+
+TEMPLATE = {"spec": {"containers": [{"name": "main", "image": "tpu-image"}]}}
+
+
+def wait_for(predicate, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = predicate()
+        if result:
+            return result
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class Cluster:
+    """Real controller loop against the in-memory apiserver."""
+
+    def __init__(self):
+        self.api = InMemoryAPIServer()
+        self.controller = TPUJobController(self.api)
+        self.stop = threading.Event()
+        self.thread = threading.Thread(
+            target=self.controller.run,
+            kwargs={"threadiness": 2, "stop": self.stop},
+            daemon=True,
+        )
+        self.thread.start()
+
+    def shutdown(self):
+        self.stop.set()
+        self.thread.join(timeout=10)
+
+    # -- hand-driven "kubelet" (envtest has none either) --
+
+    def set_pod_phase(self, name: str, phase: str, reason: str = ""):
+        pod = self.api.get("pods", "default", name)
+        pod["status"] = {"phase": phase}
+        if reason:
+            pod["status"]["reason"] = reason
+        self.api.update_status("pods", pod)
+
+    def set_workers_phase(self, job_name: str, replicas: int, phase: str):
+        for i in range(replicas):
+            self.set_pod_phase(f"{job_name}-worker-{i}", phase)
+
+    def complete_launcher(self, job_name: str):
+        launcher = self.api.get("jobs", "default", job_name + "-launcher")
+        launcher["status"] = {
+            "conditions": [{"type": "Complete", "status": "True"}],
+            "completionTime": time.time(),
+        }
+        self.api.update_status("jobs", launcher)
+
+    # -- event checker (main_test.go:116-178 analog) --
+
+    def assert_events_in_order(self, job_name: str, expected: list[tuple[str, str]]):
+        """Every (type, reason) in ``expected`` must appear for this job,
+        in order (other events may interleave)."""
+        events = [
+            (e["type"], e["reason"])
+            for e in self.api.list("events", "default", None)
+            if e.get("involvedObject", {}).get("name") == job_name
+        ]
+        it = iter(events)
+        for want in expected:
+            for got in it:
+                if got == want:
+                    break
+            else:
+                raise AssertionError(
+                    f"event {want} missing/out of order; saw {events}"
+                )
+
+    def get_job(self, name: str) -> TPUJob:
+        return TPUJob.from_dict(self.api.get("tpujobs", "default", name))
+
+
+@pytest.fixture()
+def cluster():
+    c = Cluster()
+    yield c
+    c.shutdown()
+
+
+def new_job(name="int-job", workers=4, launcher=False) -> dict:
+    job = TPUJob()
+    job.metadata.name = name
+    job.metadata.namespace = "default"
+    job.spec = TPUJobSpec(
+        tpu=TPUSpec(accelerator_type="v5e-16"),
+        replica_specs={
+            REPLICA_TYPE_WORKER: ReplicaSpec(replicas=workers, template=dict(TEMPLATE))
+        },
+    )
+    if launcher:
+        job.spec.replica_specs[REPLICA_TYPE_LAUNCHER] = ReplicaSpec(
+            template={"spec": {"containers": [{"name": "l", "image": "tpu-image"}]}}
+        )
+    return job.to_dict()
+
+
+class TestLauncherlessLifecycle:
+    def test_created_running_succeeded_with_ordered_events(self, cluster):
+        cluster.api.create("tpujobs", new_job())
+        wait_for(
+            lambda: len(cluster.api.list("pods", "default", None)) == 4,
+            msg="4 worker pods",
+        )
+        # Dependents exist without any kubelet.
+        assert cluster.api.get("services", "default", "int-job-worker")
+        assert cluster.api.get("configmaps", "default", "int-job-config")
+
+        cluster.set_workers_phase("int-job", 4, "Running")
+        wait_for(
+            lambda: st.has_condition(cluster.get_job("int-job").status, "Running"),
+            msg="Running condition",
+        )
+        cluster.set_workers_phase("int-job", 4, "Succeeded")
+        wait_for(
+            lambda: st.is_succeeded(cluster.get_job("int-job").status),
+            msg="Succeeded condition",
+        )
+        cluster.assert_events_in_order(
+            "int-job",
+            [
+                ("Normal", st.TPUJOB_CREATED_REASON),
+                ("Normal", st.TPUJOB_RUNNING_REASON),
+                ("Normal", st.TPUJOB_SUCCEEDED_REASON),
+            ],
+        )
+
+    def test_worker_failure_is_terminal_and_ordered(self, cluster):
+        cluster.api.create("tpujobs", new_job(name="fail-job"))
+        wait_for(
+            lambda: len(cluster.api.list("pods", "default", None)) == 4,
+            msg="pods",
+        )
+        cluster.set_workers_phase("fail-job", 4, "Running")
+        wait_for(
+            lambda: st.has_condition(cluster.get_job("fail-job").status, "Running"),
+            msg="Running",
+        )
+        cluster.set_pod_phase("fail-job-worker-2", "Failed")
+        wait_for(
+            lambda: st.is_failed(cluster.get_job("fail-job").status),
+            msg="Failed condition",
+        )
+        cluster.assert_events_in_order(
+            "fail-job",
+            [
+                ("Normal", st.TPUJOB_CREATED_REASON),
+                ("Normal", st.TPUJOB_RUNNING_REASON),
+                ("Warning", st.TPUJOB_FAILED_REASON),
+            ],
+        )
+
+
+class TestLauncherLifecycle:
+    def test_launcher_completion_drives_success(self, cluster):
+        cluster.api.create("tpujobs", new_job(name="l-job", launcher=True))
+        wait_for(
+            lambda: cluster.api.list("jobs", "default", None), msg="launcher Job"
+        )
+        cluster.set_workers_phase("l-job", 4, "Running")
+        cluster.complete_launcher("l-job")
+        wait_for(
+            lambda: st.is_succeeded(cluster.get_job("l-job").status),
+            msg="Succeeded via launcher",
+        )
+
+
+class TestElasticUnderRealLoop:
+    def test_resize_restamps_and_emits_restarting(self, cluster):
+        cluster.api.create("tpujobs", new_job(name="el-job", workers=4))
+        wait_for(
+            lambda: len(cluster.api.list("pods", "default", None)) == 4,
+            msg="initial pods",
+        )
+        job = cluster.api.get("tpujobs", "default", "el-job")
+        job["spec"]["tpu"]["numSlices"] = 2
+        job["spec"]["tpuReplicaSpecs"]["Worker"]["replicas"] = 8
+        cluster.api.update("tpujobs", job)
+
+        def resized():
+            pods = cluster.api.list("pods", "default", None)
+            if len(pods) != 8:
+                return False
+            return all(
+                p["metadata"]["annotations"][constants.WORLD_SIZE_ANNOTATION] == "8"
+                for p in pods
+            )
+
+        wait_for(resized, msg="8 restamped pods")
+        cluster.assert_events_in_order(
+            "el-job",
+            [
+                ("Normal", st.TPUJOB_CREATED_REASON),
+                ("Normal", st.TPUJOB_RESTARTING_REASON),
+            ],
+        )
+
+
+class TestSuspendResume:
+    def test_suspend_tears_down_resume_recreates(self, cluster):
+        cluster.api.create("tpujobs", new_job(name="s-job"))
+        wait_for(
+            lambda: len(cluster.api.list("pods", "default", None)) == 4,
+            msg="pods up",
+        )
+        job = cluster.api.get("tpujobs", "default", "s-job")
+        job["spec"].setdefault("runPolicy", {})["suspend"] = True
+        cluster.api.update("tpujobs", job)
+        wait_for(
+            lambda: len(cluster.api.list("pods", "default", None)) == 0,
+            msg="pods torn down",
+        )
+        wait_for(
+            lambda: st.is_suspended(cluster.get_job("s-job").status),
+            msg="Suspended condition",
+        )
+        job = cluster.api.get("tpujobs", "default", "s-job")
+        job["spec"]["runPolicy"]["suspend"] = False
+        cluster.api.update("tpujobs", job)
+        wait_for(
+            lambda: len(cluster.api.list("pods", "default", None)) == 4,
+            msg="pods recreated",
+        )
+        cluster.assert_events_in_order(
+            "s-job",
+            [
+                ("Normal", st.TPUJOB_CREATED_REASON),
+                ("Normal", st.TPUJOB_SUSPENDED_REASON),
+                ("Normal", st.TPUJOB_RESUMED_REASON),
+            ],
+        )
