@@ -75,6 +75,16 @@ class CheckpointManager:
         log.info("resumed from checkpoint step %d (%s)", step, self.directory)
         return step, state
 
+    def read_latest(self) -> tuple[Optional[int], Any]:
+        """Inspection/tooling path: read the newest checkpoint as plain
+        fully-replicated host arrays, with no sharding template. NOT for
+        training resume (no shardings, whole state on every host) — use
+        :meth:`restore_latest` there."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None, None
+        return step, self._mgr.restore(step)
+
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()
 
