@@ -5,14 +5,19 @@ Reference analog: /root/reference/v2/cmd/mpi-operator/ — flags
 election (server.go:210-257), /healthz (:192-208), Prometheus /metrics
 (main.go:29-40), then the controller run loop.
 
-Backends: ``--backend memory`` boots the in-memory API server with the
-LocalPodRunner kubelet sim (a self-contained "cluster in a process" —
-useful for demos and as the integration surface); a real-cluster REST
-backend slots in behind the same InMemoryAPIServer interface.
+Backends:
+- ``--backend memory`` boots the in-memory API server with the
+  LocalPodRunner kubelet sim (a self-contained "cluster in a process" —
+  useful for demos and as the integration surface);
+- ``--backend kube`` talks to a real kube-apiserver over REST
+  (kubeconfig / in-cluster config, server.go:103-109 analog) — the
+  cluster's kubelet and GC do what LocalPodRunner simulates locally.
 
 Run:  python -m mpi_operator_tpu.cmd.operator --help
       python -m mpi_operator_tpu.cmd.operator --backend memory \
           --apply examples/v2beta1/pi/pi.yaml --exit-on-completion
+      python -m mpi_operator_tpu.cmd.operator --backend kube \
+          --kubeconfig ~/.kube/config --namespace training
 """
 
 from __future__ import annotations
@@ -60,8 +65,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="enable leader election for HA deployments")
     p.add_argument("--lock-namespace", default="default",
                    help="namespace of the leader-election Lease")
-    p.add_argument("--backend", choices=["memory"], default="memory",
-                   help="cluster backend (memory = in-process apiserver + kubelet sim)")
+    p.add_argument("--backend", choices=["memory", "kube"], default="memory",
+                   help="cluster backend: memory = in-process apiserver + "
+                        "kubelet sim; kube = real cluster over REST")
+    p.add_argument("--kubeconfig", default="",
+                   help="path to kubeconfig (default: $KUBECONFIG, then "
+                        "~/.kube/config, then in-cluster config)")
+    p.add_argument("--kube-context", default="",
+                   help="kubeconfig context to use (default: current-context)")
     p.add_argument("--apply", action="append", default=[],
                    help="TPUJob YAML file(s) to apply at startup")
     p.add_argument("--exit-on-completion", action="store_true",
@@ -110,24 +121,53 @@ def start_monitoring(port: int, registry: metrics.Registry, health_fn,
     return server
 
 
-def check_crd_exists(api: InMemoryAPIServer) -> None:
-    """CRD preflight (server.go:287-299 analog): fail fast if the backend
-    does not serve the TPUJob resource."""
+def check_crd_exists(api, namespace: str = "") -> None:
+    """CRD preflight (server.go:287-299 analog): fail fast, with a clear
+    diagnostic, on any of the common startup failures — CRD missing,
+    apiserver unreachable, bad credentials, RBAC denial. Lists in the
+    watched namespace so namespace-scoped RBAC passes the preflight."""
+    from ..runtime.apiserver import ApiError
+
     try:
-        api.list("tpujobs")
+        api.list("tpujobs", namespace or None)
     except NotFoundError:
         print(
-            "CRD tpujobs.kubeflow.org not served; install the CRD first",
+            "CRD tpujobs.kubeflow.org not served; install the CRD first "
+            "(kubectl apply -f crd/kubeflow.org_tpujobs.yaml)",
             file=sys.stderr,
         )
         raise SystemExit(1)
+    except ApiError as e:
+        print(f"cannot reach the cluster backend: {e}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def build_backend(args):
+    """Returns (api, runner): the cluster backend plus, for the memory
+    backend only, the in-process kubelet sim (a real cluster brings its
+    own kubelet and garbage collector)."""
+    if args.backend == "kube":
+        from ..runtime.kube import KubeAPIServer, load_config
+
+        config = load_config(args.kubeconfig or None,
+                             args.kube_context or None)
+        print(f"connecting to apiserver {config.host}")
+        return KubeAPIServer(config, user_agent=f"tpu-operator/{_ua()}"), None
+    api = InMemoryAPIServer()
+    return api, LocalPodRunner(api)
+
+
+def _ua() -> str:
+    from ..version import VERSION
+
+    return VERSION
 
 
 def run(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
-    api = InMemoryAPIServer()
-    check_crd_exists(api)
+    api, runner = build_backend(args)
+    check_crd_exists(api, args.namespace)
     registry = metrics.Registry()
     is_leader = metrics.new_gauge(
         "tpu_operator_is_leader", "1 if this replica is the leader", (), registry
@@ -139,8 +179,8 @@ def run(argv=None) -> int:
         registry=registry,
     )
     # Controller metrics share the exposed registry.
-    runner = LocalPodRunner(api)
-    runner.start()
+    if runner is not None:
+        runner.start()
 
     applied: list[tuple[str, str]] = []
     import yaml
@@ -162,11 +202,22 @@ def run(argv=None) -> int:
                         file=sys.stderr,
                     )
                     return 1
-                created = api.create("tpujobs", doc)
+                from ..runtime.apiserver import AlreadyExistsError
+
+                try:
+                    created = api.create("tpujobs", doc)
+                    verb = "applied"
+                except AlreadyExistsError:
+                    # Cluster state persists across operator runs (unlike
+                    # the memory backend): adopt the existing job.
+                    created = api.get(
+                        "tpujobs", meta["namespace"], meta["name"]
+                    )
+                    verb = "adopted existing"
                 applied.append(
                     (created["metadata"]["namespace"], created["metadata"]["name"])
                 )
-                print(f"applied TPUJob {applied[-1][0]}/{applied[-1][1]}")
+                print(f"{verb} TPUJob {applied[-1][0]}/{applied[-1][1]}")
 
     stop = threading.Event()
 
@@ -212,6 +263,9 @@ def run(argv=None) -> int:
     for t in threads:
         t.start()
 
+    # The memory backend is free to poll fast; against a real apiserver
+    # every poll is an HTTP GET per applied job, so back off.
+    poll_interval = 0.2 if args.backend == "memory" else 2.0
     try:
         while not stop.is_set():
             if args.exit_on_completion and applied:
@@ -237,12 +291,14 @@ def run(argv=None) -> int:
                             f"TPUJob {ns}/{name}: {final['type']} ({final.get('reason', '')})"
                         )
                     stop.set()
-                    runner.stop()
+                    if runner is not None:
+                        runner.stop()
                     return 0 if all(f["type"] == "Succeeded" for _, _, f in finals) else 1
-            time.sleep(0.2)
+            time.sleep(poll_interval)
     except KeyboardInterrupt:
         stop.set()
-    runner.stop()
+    if runner is not None:
+        runner.stop()
     return 0
 
 

@@ -105,14 +105,21 @@ RESOURCES: dict[str, ResourceType] = {
 class Watch:
     """One watch stream: a buffered queue of events plus a stop handle."""
 
-    def __init__(self, server: "InMemoryAPIServer", resource: str):
+    def __init__(self, server: "InMemoryAPIServer", resource: str,
+                 namespace: Optional[str] = None):
         self._server = server
         self.resource = resource
+        self.namespace = namespace  # None = cluster-wide
         self._events: list[WatchEvent] = []
         self._cond = threading.Condition()
         self._stopped = False
 
     def _deliver(self, event: WatchEvent) -> None:
+        if self.namespace and (
+            (event.object.get("metadata") or {}).get("namespace", "")
+            != self.namespace
+        ):
+            return
         with self._cond:
             if self._stopped:
                 return
@@ -294,6 +301,10 @@ class InMemoryAPIServer:
             obj = self._store[resource].pop((namespace, name), None)
             if obj is None:
                 raise NotFoundError(resource, f"{namespace}/{name}")
+            # Deletion is a write: the DELETED event carries a fresh
+            # resourceVersion (kube semantics — watch streams stay
+            # rv-monotonic, which the HTTP frontend's watch cache needs).
+            obj["metadata"]["resourceVersion"] = str(next(self._rv))
             self._record("delete", resource, obj)
             self._notify(DELETED, resource, obj)
             self._garbage_collect(obj["metadata"].get("uid"), namespace)
@@ -321,9 +332,12 @@ class InMemoryAPIServer:
 
     # -- watch -----------------------------------------------------------
 
-    def watch(self, resource: str) -> Watch:
+    def watch(self, resource: str, namespace: Optional[str] = None) -> Watch:
+        """Open a watch; ``namespace`` scopes delivery (None =
+        cluster-wide), mirroring the kube backend's namespaced watch
+        paths so RBAC-scoped deployments work identically."""
         self._check_resource(resource)
-        watch = Watch(self, resource)
+        watch = Watch(self, resource, namespace)
         with self._lock:
             self._watches.append(watch)
         return watch

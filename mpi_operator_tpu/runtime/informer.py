@@ -119,6 +119,8 @@ class Informer:
 
         Opening the watch first guarantees no lost updates: anything that
         changes between list and first pump arrives as a watch event.
+        Namespace-scoped informers open namespace-scoped watches/lists so
+        RBAC-scoped deployments never need cluster-wide permissions.
         Re-entrant (leadership regained after a step-down): the fresh list
         *replaces* the previous term's cache, and objects that disappeared
         while we were not watching fire on_delete instead of lingering as
@@ -127,10 +129,18 @@ class Informer:
         with self._lock:
             if self._watch is not None:
                 return
-            self._watch = self._api.watch(self.resource)
+            ns = self.namespace or None
+            self._watch = self._api.watch(self.resource, namespace=ns)
+            # REST watches already paid for a baseline LIST (their 410
+            # resume mirror); reuse it instead of issuing a second full
+            # LIST per resource against the apiserver.
+            if hasattr(self._watch, "baseline"):
+                listing = self._watch.baseline()
+            else:
+                listing = self._api.list(self.resource, ns)
             fresh = {
                 meta_namespace_key(obj): obj
-                for obj in self._api.list(self.resource)
+                for obj in listing
                 if self._in_scope(obj)
             }
             removed = [
