@@ -1,0 +1,73 @@
+"""Submit a TPUJob to a real cluster and wait for it to finish.
+
+The SDK analog of the reference's usage example
+(/root/reference/sdk/python/v1/tensorflow-mnist.py), pointed at the
+real-cluster REST backend instead of a fake:
+
+    python submit_and_wait.py --kubeconfig ~/.kube/config \
+        --namespace training --accelerator v5e-16 --workers 4
+
+Works against any apiserver the kubeconfig reaches — including the
+framework's own envtest-style HTTP frontend
+(mpi_operator_tpu.runtime.httpserver) for local rehearsal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpujob import (
+    TPUJobApi,
+    V2beta1ReplicaSpec,
+    V2beta1TPUJob,
+    V2beta1TPUJobSpec,
+    V2beta1TPUSpec,
+    kube_backend,
+)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--name", default="sdk-train")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--kubeconfig", default=None)
+    p.add_argument("--accelerator", default="v5e-16")
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--image", default="tpu-job-operator/base:latest")
+    p.add_argument("--model", default="bert-base")
+    p.add_argument("--timeout", type=float, default=3600.0)
+    args = p.parse_args()
+
+    api = TPUJobApi(kube_backend(args.kubeconfig), namespace=args.namespace)
+    job = V2beta1TPUJob(
+        metadata={"name": args.name},
+        spec=V2beta1TPUJobSpec(
+            tpu=V2beta1TPUSpec(accelerator_type=args.accelerator),
+            tpu_replica_specs={
+                "Worker": V2beta1ReplicaSpec(
+                    replicas=args.workers,
+                    template={"spec": {"containers": [{
+                        "name": "main",
+                        "image": args.image,
+                        "command": [
+                            "python", "-m", "mpi_operator_tpu.cmd.train",
+                            f"--model={args.model}",
+                        ],
+                    }]}},
+                ),
+            },
+        ),
+    )
+    created = api.create(job)
+    print(f"created TPUJob {args.namespace}/{created.name}")
+
+    done = api.wait_for_condition(args.name, "Succeeded",
+                                  timeout=args.timeout)
+    cond = done.condition("Succeeded")
+    print(f"TPUJob {args.name}: Succeeded ({cond.reason})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,3 +135,67 @@ def operator_runtime_backend(api_server) -> TPUJobBackend:
     """Wrap an ``mpi_operator_tpu.runtime.apiserver.InMemoryAPIServer``
     (or anything with its surface) as an SDK backend."""
     return _OperatorRuntimeBackend(api_server)
+
+
+def kube_backend(kubeconfig: Optional[str] = None,
+                 context: Optional[str] = None) -> TPUJobBackend:
+    """Real-cluster backend over the framework's stdlib REST client
+    (mpi_operator_tpu.runtime.kube.KubeAPIServer): kubeconfig /
+    in-cluster config, exec credential plugins, no extra dependencies.
+
+    Reference analog: the generated kube-REST SDK client,
+    /root/reference/sdk/python/v1/mpijob/api_client.py.
+    """
+    from mpi_operator_tpu.runtime.kube import KubeAPIServer, load_config
+
+    return _OperatorRuntimeBackend(KubeAPIServer(load_config(
+        kubeconfig, context
+    )))
+
+
+class _CustomObjectsBackend:
+    """Adapter over the official kubernetes client's CustomObjectsApi,
+    for users already standardized on that stack."""
+
+    def __init__(self, custom_objects_api):
+        self._api = custom_objects_api
+
+    def create(self, namespace: str, body: dict) -> dict:
+        body.setdefault("apiVersion", f"{GROUP}/{VERSION}")
+        body.setdefault("kind", "TPUJob")
+        return self._api.create_namespaced_custom_object(
+            GROUP, VERSION, namespace, PLURAL, body
+        )
+
+    def get(self, namespace: str, name: str) -> dict:
+        return self._api.get_namespaced_custom_object(
+            GROUP, VERSION, namespace, PLURAL, name
+        )
+
+    def list(self, namespace: str):
+        return self._api.list_namespaced_custom_object(
+            GROUP, VERSION, namespace, PLURAL
+        ).get("items", [])
+
+    def update(self, namespace: str, name: str, body: dict) -> dict:
+        body.setdefault("apiVersion", f"{GROUP}/{VERSION}")
+        body.setdefault("kind", "TPUJob")
+        return self._api.replace_namespaced_custom_object(
+            GROUP, VERSION, namespace, PLURAL, name, body
+        )
+
+    def delete(self, namespace: str, name: str) -> None:
+        self._api.delete_namespaced_custom_object(
+            GROUP, VERSION, namespace, PLURAL, name
+        )
+
+
+def custom_objects_backend(custom_objects_api=None) -> TPUJobBackend:
+    """SDK backend over the official ``kubernetes`` package's
+    CustomObjectsApi (optional dependency — imported only here)."""
+    if custom_objects_api is None:
+        import kubernetes  # optional dependency
+
+        kubernetes.config.load_config()
+        custom_objects_api = kubernetes.client.CustomObjectsApi()
+    return _CustomObjectsBackend(custom_objects_api)
