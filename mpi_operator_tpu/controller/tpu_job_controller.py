@@ -640,6 +640,19 @@ class TPUJobController:
           containers in-place; a deleted/failed pod needs the controller)
           — gated by ``allow_failure_restart`` (budget + rejoinability).
         """
+        worker_spec = job.spec.replica_specs.get(REPLICA_TYPE_WORKER)
+        restart_policy = worker_spec.restart_policy if worker_spec else ""
+        # Failure is checked BEFORE staleness: a Failed pod that also has
+        # a stale stamp must be replaced under the failure reason (which
+        # consumes runPolicy.backoffLimit) — otherwise repeated resizes
+        # during a crash loop would replace workers forever without the
+        # budget ever bounding it.
+        if restart_policy == RESTART_POLICY_ON_FAILURE and \
+                _pod_phase(pod) == POD_FAILED:
+            if not allow_failure_restart:
+                return None  # budget exhausted; never launder via staleness
+            reason = (pod.get("status") or {}).get("reason", "")
+            return f"failed{f' ({reason})' if reason else ''}"
         annotations = pod["metadata"].get("annotations") or {}
         stamp = annotations.get(constants.WORLD_SIZE_ANNOTATION)
         if stamp != str(replicas):
@@ -647,15 +660,6 @@ class TPUJobController:
             # treated as stale: keeping it would leave its rendezvous env
             # encoding an unknown world size and hang the gang.
             return f"world size {stamp or 'unknown'} -> {replicas}"
-        worker_spec = job.spec.replica_specs.get(REPLICA_TYPE_WORKER)
-        restart_policy = worker_spec.restart_policy if worker_spec else ""
-        if (
-            allow_failure_restart
-            and restart_policy == RESTART_POLICY_ON_FAILURE
-            and _pod_phase(pod) == POD_FAILED
-        ):
-            reason = (pod.get("status") or {}).get("reason", "")
-            return f"failed{f' ({reason})' if reason else ''}"
         return None
 
     def _delete_worker_pods(self, job: TPUJob) -> None:

@@ -75,47 +75,89 @@ def native_available() -> bool:
 # ---------------------------------------------------------------------------
 
 
+_HEADER_TIMEOUT_S = 3.0  # per-connection budget for the 8-byte header
+
+
 def _py_serve(port: int, world_size: int, timeout_ms: int) -> int:
+    import selectors
+
     deadline = time.monotonic() + timeout_ms / 1000.0
     # conn per rank; a re-check-in (client retry after a dropped connection)
     # replaces the stale conn so the retrying rank still gets its GO.
     conn_by_rank: dict[int, socket.socket] = {}
+    # Half-read headers get their own short deadline: a silent connection
+    # (port scanner, health probe) is dropped alone instead of serializing
+    # the accept loop until the gang deadline (same design as
+    # barrier.cpp's PendingConn poll set).
+    pending: dict[socket.socket, tuple[bytes, float]] = {}
+    sel = selectors.DefaultSelector()
     try:
         with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as srv:
             srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             srv.bind(("0.0.0.0", port))
             srv.listen(world_size + 8)
-            srv.settimeout(0.2)
+            srv.setblocking(False)
+            sel.register(srv, selectors.EVENT_READ)
             while len(conn_by_rank) < world_size:
-                if time.monotonic() >= deadline:
+                now = time.monotonic()
+                if now >= deadline:
                     return -1
-                try:
-                    conn, _ = srv.accept()
-                except socket.timeout:
-                    continue
-                try:
-                    conn.settimeout(max(deadline - time.monotonic(), 0.01))
-                    hdr = b""
-                    while len(hdr) < 8:
-                        chunk = conn.recv(8 - len(hdr))
-                        if not chunk:
-                            break
-                        hdr += chunk
-                    if len(hdr) != 8 or hdr[:4] != MAGIC:
+                for conn, (buf, conn_deadline) in list(pending.items()):
+                    if now >= conn_deadline:
+                        sel.unregister(conn)
+                        del pending[conn]
                         conn.close()
+                for key, _ in sel.select(timeout=0.2):
+                    sock = key.fileobj
+                    if sock is srv:
+                        while True:
+                            try:
+                                conn, _ = srv.accept()
+                            except (BlockingIOError, InterruptedError,
+                                    ConnectionAbortedError):
+                                break  # drained for now
+                            # Hard errors (EMFILE under a flood) propagate
+                            # to the outer handler -> rc=-1, not a silent
+                            # spin to the gang deadline.
+                            conn.setblocking(False)
+                            pending[conn] = (
+                                b"", time.monotonic() + _HEADER_TIMEOUT_S
+                            )
+                            sel.register(conn, selectors.EVENT_READ)
                         continue
-                    (rank,) = struct.unpack("<I", hdr[4:])
+                    buf, conn_deadline = pending[sock]
+                    try:
+                        chunk = sock.recv(8 - len(buf))
+                    except BlockingIOError:
+                        continue
+                    except OSError:
+                        chunk = b""
+                    if not chunk:  # closed before full header
+                        sel.unregister(sock)
+                        del pending[sock]
+                        sock.close()
+                        continue
+                    buf += chunk
+                    if len(buf) < 8:
+                        pending[sock] = (buf, conn_deadline)
+                        continue
+                    sel.unregister(sock)
+                    del pending[sock]
+                    if buf[:4] != MAGIC:
+                        sock.close()
+                        continue
+                    (rank,) = struct.unpack("<I", buf[4:])
                     if rank >= world_size:
-                        conn.close()
+                        sock.close()
                         continue
                     old = conn_by_rank.pop(rank, None)
                     if old is not None:
                         old.close()
-                    conn_by_rank[rank] = conn
-                except OSError:
-                    conn.close()
+                    conn_by_rank[rank] = sock
             for conn in conn_by_rank.values():
                 try:
+                    # Back to blocking for the 4-byte release write.
+                    conn.settimeout(max(deadline - time.monotonic(), 0.01))
                     conn.sendall(GO)
                 except OSError:
                     pass  # rank died post-check-in; jax.distributed will see it
@@ -123,7 +165,8 @@ def _py_serve(port: int, world_size: int, timeout_ms: int) -> int:
     except OSError:
         return -1
     finally:
-        for conn in conn_by_rank.values():
+        sel.close()
+        for conn in list(conn_by_rank.values()) + list(pending):
             try:
                 conn.close()
             except OSError:

@@ -78,11 +78,28 @@ void set_nonblock(int fd) { fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOC
 
 }  // namespace
 
+// A connection that has been accepted but not yet sent its full 8-byte
+// header. Gets its own short deadline so a silent connection (port
+// scanner, health probe, misbehaving proxy) is dropped instead of
+// stalling the gang.
+struct PendingConn {
+  int fd;
+  char buf[8];
+  size_t got;
+  int64_t deadline;
+};
+
+constexpr int kHeaderTimeoutMs = 3000;
+
 extern "C" {
 
 // Serve one barrier round: accept connections until `world_size` distinct
 // ranks have checked in, then release them all. Returns 0 on success,
 // -ETIMEDOUT / -errno on failure. Binds 0.0.0.0:port.
+//
+// Single-threaded, but never serialized on one peer: the listener and
+// every half-read header are polled together, so a stalled connection
+// costs nothing but its own kHeaderTimeoutMs.
 int tpujob_barrier_serve(int port, int world_size, int timeout_ms) {
   if (world_size <= 0 || world_size > 1 << 20) return -EINVAL;
   int64_t deadline = now_ms() + timeout_ms;
@@ -106,6 +123,7 @@ int tpujob_barrier_serve(int port, int world_size, int timeout_ms) {
   // fd per rank; a re-check-in (client retry after a dropped connection)
   // replaces the stale fd so the retrying rank still gets its GO.
   std::vector<int> fd_by_rank(world_size, -1);
+  std::vector<PendingConn> pending;
   int arrived = 0;
   int rc = 0;
 
@@ -115,42 +133,87 @@ int tpujob_barrier_serve(int port, int world_size, int timeout_ms) {
       rc = -ETIMEDOUT;
       break;
     }
-    struct pollfd pfd = {srv, POLLIN, 0};
-    int pr = poll(&pfd, 1, static_cast<int>(left));
-    if (pr < 0) {
-      if (errno == EINTR) continue;
+    std::vector<pollfd> pfds;
+    pfds.push_back({srv, POLLIN, 0});
+    for (const auto& pc : pending) pfds.push_back({pc.fd, POLLIN, 0});
+    // Cap the poll so per-connection deadlines are enforced promptly.
+    int wait = static_cast<int>(left < 200 ? left : 200);
+    int pr = poll(pfds.data(), pfds.size(), wait);
+    if (pr < 0 && errno != EINTR) {
       rc = -errno;
       break;
     }
-    if (pr == 0) {
-      rc = -ETIMEDOUT;
-      break;
+
+    if (pr > 0 && (pfds[0].revents & POLLIN)) {
+      while (true) {
+        int fd = accept(srv, nullptr, nullptr);
+        if (fd < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR ||
+              errno == ECONNABORTED) {
+            break;  // drained for now
+          }
+          // Hard error (e.g. EMFILE under a connection flood): surface
+          // it instead of spinning to a generic timeout.
+          rc = -errno;
+          break;
+        }
+        set_nonblock(fd);
+        pending.push_back({fd, {}, 0, now_ms() + kHeaderTimeoutMs});
+      }
+      if (rc != 0) break;
     }
-    int fd = accept(srv, nullptr, nullptr);
-    if (fd < 0) {
-      if (errno == EAGAIN || errno == EINTR) continue;
-      rc = -errno;
-      break;
+
+    int64_t now = now_ms();
+    std::vector<PendingConn> still_pending;
+    for (size_t i = 0; i < pending.size(); ++i) {
+      PendingConn& pc = pending[i];
+      bool readable = pr > 0 && i + 1 < pfds.size() &&
+                      (pfds[i + 1].revents & (POLLIN | POLLHUP | POLLERR));
+      bool drop = false;
+      if (readable) {
+        ssize_t r = read(pc.fd, pc.buf + pc.got, sizeof(pc.buf) - pc.got);
+        if (r > 0) {
+          pc.got += static_cast<size_t>(r);
+        } else if (r == 0 || (errno != EAGAIN && errno != EINTR)) {
+          drop = true;  // peer closed or hard error before full header
+        }
+      }
+      if (!drop && pc.got == sizeof(pc.buf)) {
+        if (memcmp(pc.buf, kMagic, 4) != 0) {
+          drop = true;  // garbled (health probe?): ignore
+        } else {
+          // Rank is little-endian on the wire (matches the Python
+          // engine's struct.pack('<I', rank)) on every architecture.
+          uint32_t rank = static_cast<uint32_t>(
+                              static_cast<uint8_t>(pc.buf[4])) |
+                          static_cast<uint32_t>(
+                              static_cast<uint8_t>(pc.buf[5])) << 8 |
+                          static_cast<uint32_t>(
+                              static_cast<uint8_t>(pc.buf[6])) << 16 |
+                          static_cast<uint32_t>(
+                              static_cast<uint8_t>(pc.buf[7])) << 24;
+          if (rank >= static_cast<uint32_t>(world_size)) {
+            drop = true;  // out-of-range: drop quietly
+          } else {
+            if (fd_by_rank[rank] >= 0) {
+              close(fd_by_rank[rank]);  // retry supersedes stale conn
+            } else {
+              ++arrived;
+            }
+            fd_by_rank[rank] = pc.fd;
+            continue;  // consumed; not pending anymore
+          }
+        }
+      }
+      if (drop || now >= pc.deadline) {
+        close(pc.fd);  // slow/silent/garbled connection: drop it alone
+      } else {
+        still_pending.push_back(pc);
+      }
     }
-    char hdr[8];
-    if (io_exact(fd, hdr, sizeof(hdr), /*write=*/false, deadline) != 0 ||
-        memcmp(hdr, kMagic, 4) != 0) {
-      close(fd);  // stray/garbled connection (health probe?): ignore
-      continue;
-    }
-    uint32_t rank;
-    memcpy(&rank, hdr + 4, 4);
-    if (rank >= static_cast<uint32_t>(world_size)) {
-      close(fd);  // out-of-range: drop quietly
-      continue;
-    }
-    if (fd_by_rank[rank] >= 0) {
-      close(fd_by_rank[rank]);  // retry supersedes the stale connection
-    } else {
-      ++arrived;
-    }
-    fd_by_rank[rank] = fd;
+    pending.swap(still_pending);
   }
+  for (const auto& pc : pending) close(pc.fd);
 
   if (rc == 0) {
     for (int fd : fd_by_rank) {
@@ -203,8 +266,12 @@ int tpujob_barrier_wait(const char* host, int port, int rank, int timeout_ms) {
 
     char hdr[8];
     memcpy(hdr, kMagic, 4);
+    // Little-endian on the wire, byte-wise (architecture-independent).
     uint32_t r = static_cast<uint32_t>(rank);
-    memcpy(hdr + 4, &r, 4);
+    hdr[4] = static_cast<char>(r & 0xff);
+    hdr[5] = static_cast<char>((r >> 8) & 0xff);
+    hdr[6] = static_cast<char>((r >> 16) & 0xff);
+    hdr[7] = static_cast<char>((r >> 24) & 0xff);
     char go[4];
     if (io_exact(fd, hdr, sizeof(hdr), /*write=*/true, deadline) == 0 &&
         io_exact(fd, go, sizeof(go), /*write=*/false, deadline) == 0 &&

@@ -11,6 +11,7 @@ import pathlib
 import socket
 import subprocess
 import threading
+import time
 
 import pytest
 
@@ -206,3 +207,67 @@ class TestGangBarrier:
                 world_size=2,
                 timeout_s=0.5,
             )
+
+
+class TestSilentConnection:
+    """A connection that sends nothing (port scanner, health probe) must
+    be dropped on its own short header deadline — it cannot stall the
+    gang (advisor finding: the old single-threaded read serialized the
+    accept loop on one silent peer)."""
+
+    @pytest.mark.parametrize("engine", ["python", "native"])
+    def test_silent_peer_does_not_block_gang(self, engine, monkeypatch):
+        if engine == "native" and not barrier.native_available():
+            pytest.skip("native lib not built")
+        monkeypatch.setattr(barrier, "_HEADER_TIMEOUT_S", 1.0, raising=True)
+        port = free_port()
+        world = 4
+        serve_fn = (
+            barrier._py_serve if engine == "python"
+            else barrier._native.tpujob_barrier_serve
+        )
+        wait_fn = (
+            (lambda h, p, r, t: barrier._py_wait(h.decode(), p, r, t))
+            if engine == "python"
+            else barrier._native.tpujob_barrier_wait
+        )
+        results: dict = {}
+
+        def server():
+            results["serve"] = serve_fn(port, world, 10_000)
+
+        threads = [threading.Thread(target=server)]
+        threads[0].start()
+        # The silent peer connects FIRST and never sends a byte. Retry the
+        # connect until the server thread has bound (no fixed sleep).
+        silent = None
+        deadline = time.monotonic() + 5.0
+        while silent is None:
+            try:
+                silent = socket.create_connection(("127.0.0.1", port))
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.02)
+        try:
+            def client(rank):
+                results[rank] = wait_fn(b"127.0.0.1", port, rank, 10_000)
+
+            threads += [
+                threading.Thread(target=client, args=(r,))
+                for r in range(world)
+            ]
+            for t in threads[1:]:
+                t.start()
+            start = time.monotonic()
+            for t in threads:
+                t.join(timeout=15)
+            elapsed = time.monotonic() - start
+            assert results["serve"] == 0
+            assert all(results[r] == 0 for r in range(world))
+            # The gang must NOT have waited out the silent peer's socket:
+            # with the old serialized read this took the full gang
+            # deadline.
+            assert elapsed < 8.0
+        finally:
+            silent.close()

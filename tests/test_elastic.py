@@ -174,6 +174,38 @@ class TestFailedWorkerRejoin:
         cond = st.get_condition(status, JOB_FAILED)
         assert cond.reason == "BackoffLimitExceeded"
 
+    def test_failed_pod_with_stale_stamp_consumes_backoff(self):
+        # Failure takes precedence over staleness: a Failed pod that ALSO
+        # carries a stale world-size stamp must be replaced under the
+        # failure reason (counting restarts), so resizes during a crash
+        # loop cannot bypass runPolicy.backoffLimit.
+        f = Fixture()
+        job = f.new_job(workers=4, backoff_limit=1)
+        job.spec.replica_specs[REPLICA_TYPE_WORKER].restart_policy = "OnFailure"
+        f.start()
+        created = f.create_job(job)
+        f.sync(created)
+        # Hand the pod a stale stamp AND a Failed phase.
+        pod = f.api.get("pods", "default", "test-job-worker-0")
+        pod["metadata"]["annotations"][constants.WORLD_SIZE_ANNOTATION] = "99"
+        f.api.update("pods", pod)
+        f.set_pod_phase("test-job-worker-0", "Failed")
+        f.sync(created)
+        assert (
+            f.get_job().status.replica_statuses[REPLICA_TYPE_WORKER].restarts
+            == 1
+        )
+        # Budget (1) spent: the next failure is terminal even if the stamp
+        # is stale again.
+        pod = f.api.get("pods", "default", "test-job-worker-0")
+        pod["metadata"]["annotations"][constants.WORLD_SIZE_ANNOTATION] = "98"
+        f.api.update("pods", pod)
+        f.set_pod_phase("test-job-worker-0", "Failed")
+        f.sync(created)
+        status = f.get_job().status
+        assert st.is_failed(status)
+        assert st.get_condition(status, JOB_FAILED).reason == "BackoffLimitExceeded"
+
     def test_no_rejoin_after_sibling_succeeded(self):
         # Once any rank exited Succeeded the gang cannot be re-formed; a
         # late failure is terminal even under OnFailure.
