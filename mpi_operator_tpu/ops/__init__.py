@@ -3,15 +3,24 @@
 The reference operator contains no kernels (it orchestrates user MPI
 programs); this layer is where our framework's *workload* half earns the
 "TPU-native" name: flash attention on the MXU via pallas, and ring
-attention over an ``sp`` mesh axis for long-context training.
+attention over an ``sp`` mesh axis for long-context training (flash
+per-hop partials merged by logsumexp, zigzag layout for causal balance).
 """
 
-from .attention import attention_reference, flash_attention
-from .ring_attention import ring_attention, ring_attention_sharded
+from .attention import attention_reference, flash_attention, flash_attention_lse
+from .ring_attention import (
+    ring_attention,
+    ring_attention_sharded,
+    zigzag_indices,
+    zigzag_inverse,
+)
 
 __all__ = [
     "attention_reference",
     "flash_attention",
+    "flash_attention_lse",
     "ring_attention",
     "ring_attention_sharded",
+    "zigzag_indices",
+    "zigzag_inverse",
 ]

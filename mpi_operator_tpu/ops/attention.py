@@ -24,10 +24,16 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30  # safe "minus infinity": avoids inf-inf → nan in masking
+
+# Sentinel ids used to encode padding inside explicit row/col id vectors:
+# padded k/v columns get +_ID_PAD (never visible to any row), padded q rows
+# get -_ID_PAD (see nothing; their output is sliced away by the wrapper).
+_ID_PAD = 2**30
 
 
 def attention_reference(
@@ -56,12 +62,45 @@ def attention_reference(
 # ---------------------------------------------------------------------------
 
 
+def _block_mask(i, j, row_ref, col_ref, *, causal, q_len, kv_len, block_q, block_k):
+    """(mask, live) for the (i-th q block, j-th k block) grid block.
+
+    Two modes: static masking from grid coordinates (padding + optional
+    aligned-causal), or — when explicit global-position id refs are given —
+    ``col_id <= row_id`` causal masking over arbitrary position labelings
+    (ring hops, zigzag layouts). ``live`` is false when no element of the
+    block can pass the mask, letting callers skip the MXU work entirely.
+    """
+    if row_ref is not None:
+        rid = row_ref[0].reshape(block_q, 1)
+        cid = col_ref[0].reshape(1, block_k)
+        mask = cid <= rid
+        live = jnp.min(col_ref[0]) <= jnp.max(row_ref[0])
+        return mask, live
+    row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = (row < q_len) & (col < kv_len)
+    if causal:
+        mask &= col <= row + (kv_len - q_len)
+        # Lowest global column of this block vs highest visible column of
+        # this q block: block is live iff some (row, col) passes the mask.
+        live = j * block_k <= i * block_q + (block_q - 1) + (kv_len - q_len)
+    else:
+        live = None  # every block is live
+    return mask, live
+
+
 def _fwd_kernel(
-    q_ref, k_ref, v_ref,  # inputs
-    o_ref, lse_ref,  # outputs
-    acc_ref, m_ref, l_ref,  # VMEM scratch, carried across the k grid axis
-    *, sm_scale, causal, q_len, kv_len, block_q, block_k,
+    *refs,
+    sm_scale, causal, use_ids, q_len, kv_len, block_q, block_k,
 ):
+    if use_ids:
+        q_ref, k_ref, v_ref, row_ref, col_ref = refs[:5]
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[5:]
+    else:
+        q_ref, k_ref, v_ref = refs[:3]
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[3:]
+        row_ref = col_ref = None
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -71,11 +110,11 @@ def _fwd_kernel(
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    mask = (row < q_len) & (col < kv_len)
-    if causal:
-        mask &= col <= row + (kv_len - q_len)
+    mask, live = _block_mask(
+        i, j, row_ref, col_ref,
+        causal=causal, q_len=q_len, kv_len=kv_len,
+        block_q=block_q, block_k=block_k,
+    )
 
     # With causal masking, blocks strictly above the diagonal contribute
     # nothing — skip their FLOPs (the grid still visits them; the MXU does
@@ -101,13 +140,10 @@ def _fwd_kernel(
             p.astype(v_ref.dtype), v_ref[0], preferred_element_type=jnp.float32
         )
 
-    if causal:
-        # Lowest global column of this block vs highest visible column of
-        # this q block: block is live iff some (row, col) passes the mask.
-        live = j * block_k <= i * block_q + (block_q - 1) + (kv_len - q_len)
-        pl.when(live)(compute)
-    else:
+    if live is None:
         compute()
+    else:
+        pl.when(live)(compute)
 
     @pl.when(j == nk - 1)
     def _finalize():
@@ -115,7 +151,7 @@ def _fwd_kernel(
         safe_l = jnp.where(l > 0.0, l, 1.0)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
         lse = jnp.where(l > 0.0, m_ref[:, :1] + jnp.log(safe_l), NEG_INF)
-        lse_ref[0] = lse[:, 0]
+        lse_ref[0, 0] = lse[:, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -133,11 +169,16 @@ def _masked_p(q, k, lse_col, mask, sm_scale):
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-    dq_ref,
-    dq_acc_ref,
-    *, sm_scale, causal, q_len, kv_len, block_q, block_k,
+    *refs,
+    sm_scale, causal, use_ids, q_len, kv_len, block_q, block_k,
 ):
+    if use_ids:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, row_ref, col_ref = refs[:8]
+        dq_ref, dq_acc_ref = refs[8:]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+        dq_ref, dq_acc_ref = refs[6:]
+        row_ref = col_ref = None
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -145,11 +186,11 @@ def _bwd_dq_kernel(
     def _init():
         dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
 
-    row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    mask = (row < q_len) & (col < kv_len)
-    if causal:
-        mask &= col <= row + (kv_len - q_len)
+    mask, live = _block_mask(
+        i, j, row_ref, col_ref,
+        causal=causal, q_len=q_len, kv_len=kv_len,
+        block_q=block_q, block_k=block_k,
+    )
 
     def compute():
         p = _masked_p(q_ref[0], k_ref[0], lse_ref[0].reshape(block_q, 1), mask, sm_scale)
@@ -162,11 +203,10 @@ def _bwd_dq_kernel(
             ds.astype(k_ref.dtype), k_ref[0], preferred_element_type=jnp.float32
         )
 
-    if causal:
-        live = j * block_k <= i * block_q + (block_q - 1) + (kv_len - q_len)
-        pl.when(live)(compute)
-    else:
+    if live is None:
         compute()
+    else:
+        pl.when(live)(compute)
 
     @pl.when(j == nk - 1)
     def _finalize():
@@ -174,14 +214,19 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-    dk_ref, dv_ref,
-    dk_acc_ref, dv_acc_ref,
-    *, sm_scale, causal, q_len, kv_len, block_q, block_k, nq,
+    *refs,
+    sm_scale, causal, use_ids, q_len, kv_len, block_q, block_k, nq,
 ):
     # Grid: (batch*kv-heads, k-blocks, group*q-blocks) — the innermost axis
     # enumerates (query head in group, q block) so dk/dv accumulate in VMEM
     # across the whole contraction for this kv head.
+    if use_ids:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, row_ref, col_ref = refs[:8]
+        dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = refs[8:]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+        dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = refs[6:]
+        row_ref = col_ref = None
     j, e = pl.program_id(1), pl.program_id(2)
     i = e % nq
     ne = pl.num_programs(2)
@@ -191,11 +236,11 @@ def _bwd_dkv_kernel(
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
-    row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    mask = (row < q_len) & (col < kv_len)
-    if causal:
-        mask &= col <= row + (kv_len - q_len)
+    mask, live = _block_mask(
+        i, j, row_ref, col_ref,
+        causal=causal, q_len=q_len, kv_len=kv_len,
+        block_q=block_q, block_k=block_k,
+    )
 
     def compute():
         p = _masked_p(q_ref[0], k_ref[0], lse_ref[0].reshape(block_q, 1), mask, sm_scale)
@@ -213,11 +258,10 @@ def _bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    if causal:
-        live = j * block_k <= i * block_q + (block_q - 1) + (kv_len - q_len)
-        pl.when(live)(compute)
-    else:
+    if live is None:
         compute()
+    else:
+        pl.when(live)(compute)
 
     @pl.when(e == ne - 1)
     def _finalize():
@@ -244,17 +288,23 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-@functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
-)
-def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k, interpret)
-    return out
+def _pad_ids(ids, multiple: int, fill: int):
+    """Pad a 1-D id vector to a block multiple and lift to [1, S_pad] (TPU
+    pallas wants ≥2-D operands)."""
+    pad = (-ids.shape[0]) % multiple
+    if pad:
+        ids = jnp.concatenate(
+            [ids, jnp.full((pad,), fill, dtype=jnp.int32)]
+        )
+    return ids.astype(jnp.int32).reshape(1, -1)
 
 
-def _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _flash_fwd_impl(
+    q, k, v, row_ids, col_ids, sm_scale, causal, block_q, block_k, interpret
+):
     bh, q_len, d = q.shape
     kv_len = k.shape[1]
+    use_ids = row_ids is not None
     # GQA: q rows map onto k/v rows `groups` apart via the BlockSpec index
     # maps — kv heads are never expanded in HBM.
     groups = bh // k.shape[0]
@@ -265,24 +315,43 @@ def _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel,
-        sm_scale=sm_scale, causal=causal,
+        sm_scale=sm_scale, causal=causal, use_ids=use_ids,
         q_len=q_len, kv_len=kv_len, block_q=block_q, block_k=block_k,
     )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
+    ]
+    operands = [qp, kp, vp]
+    if use_ids:
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, i, j: (0, i)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (0, j)),
+        ]
+        operands += [
+            _pad_ids(row_ids, block_q, -_ID_PAD),
+            _pad_ids(col_ids, block_k, _ID_PAD),
+        ]
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
-        ],
+        in_specs=in_specs,
+        # lse rides as [bh, 1, S]: a 2-D [bh, S] output with block
+        # (1, block_q) violates the TPU (8, 128) block-divisibility rule;
+        # the singleton middle axis makes the trailing block dims
+        # (1, block_q) match the array dims exactly.
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
+        # vma propagated from q so the kernel composes inside shard_map
+        # (ring attention) and outside it alike.
         out_shape=[
-            jax.ShapeDtypeStruct(qp.shape, q.dtype),
-            jax.ShapeDtypeStruct(qp.shape[:2], jnp.float32),
+            jax.ShapeDtypeStruct(qp.shape, q.dtype, vma=jax.typeof(qp).vma),
+            jax.ShapeDtypeStruct(
+                (bh, 1, qp.shape[1]), jnp.float32, vma=jax.typeof(qp).vma
+            ),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -290,51 +359,67 @@ def _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp)
-    return out[:, :q_len], lse[:, :q_len]
+    )(*operands)
+    return out[:, :q_len], lse[:, 0, :q_len]
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
-
-
-def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
-    q, k, v, out, lse = res
+def _flash_bwd_impl(
+    q, k, v, out, lse, do, dlse, row_ids, col_ids,
+    sm_scale, causal, block_q, block_k, interpret,
+):
     bh, q_len, d = q.shape
     kv_len = k.shape[1]
+    use_ids = row_ids is not None
     groups = bh // k.shape[0]
-    # delta_i = rowsum(do_i * o_i): tiny elementwise reduce — let XLA fuse it.
+    # delta_i = rowsum(do_i * o_i): tiny elementwise reduce — let XLA fuse
+    # it. A cotangent on lse enters every ds_ij of row i as +p_ij·dlse_i,
+    # which is exactly -delta_i's role — fold it in, no kernel change.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     qp = _pad_to(q, 1, block_q)
     kp = _pad_to(k, 1, block_k)
     vp = _pad_to(v, 1, block_k)
     dop = _pad_to(do, 1, block_q)
-    lsep = _pad_to(lse, 1, block_q)
-    deltap = _pad_to(delta, 1, block_q)
+    # [bh, 1, S]: see the forward's lse out_spec comment.
+    lsep = _pad_to(lse, 1, block_q)[:, None, :]
+    deltap = _pad_to(delta, 1, block_q)[:, None, :]
     nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
 
     common = dict(
-        sm_scale=sm_scale, causal=causal,
+        sm_scale=sm_scale, causal=causal, use_ids=use_ids,
         q_len=q_len, kv_len=kv_len, block_q=block_q, block_k=block_k,
     )
+    operands = [qp, kp, vp, dop, lsep, deltap]
+    id_operands = []
+    if use_ids:
+        id_operands = [
+            _pad_ids(row_ids, block_q, -_ID_PAD),
+            _pad_ids(col_ids, block_k, _ID_PAD),
+        ]
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+    ]
+    if use_ids:
+        dq_in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, i, j: (0, i)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (0, j)),
+        ]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype, vma=jax.typeof(qp).vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(*operands, *id_operands)
 
     # dk/dv: one program per kv head; the inner grid axis enumerates every
     # (query-head-in-group, q-block) pair so the accumulators also contract
@@ -342,36 +427,107 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
     def qrow(b, e):
         return b * groups + e // nq
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, e: (qrow(b, e), e % nq, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, e: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, e: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, e: (qrow(b, e), e % nq, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda b, j, e: (qrow(b, e), 0, e % nq)),
+        pl.BlockSpec((1, 1, block_q), lambda b, j, e: (qrow(b, e), 0, e % nq)),
+    ]
+    if use_ids:
+        dkv_in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, j, e: (0, e % nq)),
+            pl.BlockSpec((1, block_k), lambda b, j, e: (0, j)),
+        ]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common, nq=nq),
         grid=(bh // groups, nk, nq * groups),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, e: (qrow(b, e), e % nq, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, e: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, e: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, e: (qrow(b, e), e % nq, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, e: (qrow(b, e), e % nq)),
-            pl.BlockSpec((1, block_q), lambda b, j, e: (qrow(b, e), e % nq)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, e: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, e: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(kp.shape, k.dtype),
-            jax.ShapeDtypeStruct(vp.shape, v.dtype),
+            jax.ShapeDtypeStruct(kp.shape, k.dtype, vma=jax.typeof(kp).vma),
+            jax.ShapeDtypeStruct(vp.shape, v.dtype, vma=jax.typeof(vp).vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(*operands, *id_operands)
 
     return dq[:, :q_len], dk[:, :kv_len], dv[:, :kv_len]
 
 
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd_impl(
+        q, k, v, None, None, sm_scale, causal, block_q, block_k, interpret
+    )
+    return out
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_impl(
+        q, k, v, None, None, sm_scale, causal, block_q, block_k, interpret
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    return _flash_bwd_impl(
+        q, k, v, out, lse, do, None, None, None,
+        sm_scale, causal, block_q, block_k, interpret,
+    )
+
+
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# Variant that also returns the logsumexp — the merge quantity ring
+# attention needs to combine per-hop partial attentions. The lse output is
+# itself differentiable (its cotangent folds into delta, see
+# ``_flash_bwd_impl``), so the ring's online combine backprops exactly.
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
+)
+def _flash_lse(
+    q, k, v, row_ids, col_ids, sm_scale, causal, block_q, block_k, interpret
+):
+    return _flash_fwd_impl(
+        q, k, v, row_ids, col_ids, sm_scale, causal, block_q, block_k, interpret
+    )
+
+
+def _flash_lse_fwd(
+    q, k, v, row_ids, col_ids, sm_scale, causal, block_q, block_k, interpret
+):
+    out, lse = _flash_fwd_impl(
+        q, k, v, row_ids, col_ids, sm_scale, causal, block_q, block_k, interpret
+    )
+    return (out, lse), (q, k, v, row_ids, col_ids, out, lse)
+
+
+def _flash_lse_bwd(sm_scale, causal, block_q, block_k, interpret, res, cts):
+    q, k, v, row_ids, col_ids, out, lse = res
+    do, dlse = cts
+    dq, dk, dv = _flash_bwd_impl(
+        q, k, v, out, lse, do, dlse, row_ids, col_ids,
+        sm_scale, causal, block_q, block_k, interpret,
+    )
+    zero_ids = lambda ids: (
+        None if ids is None else np.zeros(ids.shape, jax.dtypes.float0)
+    )
+    return dq, dk, dv, zero_ids(row_ids), zero_ids(col_ids)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention(
@@ -410,3 +566,51 @@ def flash_attention(
         flat(q), flat(k), flat(v), sm_scale, causal, block_q, block_k, interpret
     )
     return out.reshape(b, h, q_len, d)
+
+
+def flash_attention_lse(
+    q, k, v,
+    *,
+    row_ids=None,
+    col_ids=None,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Flash attention returning ``(out, lse)`` — the building block for
+    ring attention's per-hop partials (lse is what lets hops merge with an
+    online-softmax combine, O(S·D) memory, never O(S²)).
+
+    ``row_ids``/``col_ids`` (1-D int32, global sequence positions of the
+    local q rows / k columns) switch masking to ``col_id <= row_id`` —
+    causal attention over arbitrary position labelings such as ring hops
+    and zigzag layouts. Without ids, ``causal`` applies the standard
+    aligned mask. Fully-masked rows return out = 0, lse = NEG_INF, which
+    the combine treats as a zero-weight partial.
+
+    Differentiable in q, k, v AND lse (the lse cotangent folds into the
+    backward kernels' delta), so ring attention's scan backprops through
+    the merge with no custom ring VJP.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected [B, H, S, D] inputs, got rank {q.ndim}")
+    if (row_ids is None) != (col_ids is None):
+        raise ValueError("row_ids and col_ids must be given together")
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+    b, h, q_len, d = q.shape
+    h_kv, kv_len = k.shape[1], k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    block_q = min(block_q, max(q_len, 1))
+    block_k = min(block_k, max(kv_len, 1))
+    flat = lambda x: x.reshape(b * x.shape[1], x.shape[2], d)
+    out, lse = _flash_lse(
+        flat(q), flat(k), flat(v), row_ids, col_ids,
+        sm_scale, causal, block_q, block_k, interpret,
+    )
+    return out.reshape(b, h, q_len, d), lse.reshape(b, h, q_len)

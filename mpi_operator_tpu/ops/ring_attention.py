@@ -4,17 +4,33 @@ Long-context training shards the *sequence* dimension across devices; no
 single chip ever holds full-length k/v. Each device keeps its local q
 shard and streams k/v shards around the ring with ``lax.ppermute``
 (nearest-neighbor ICI hops — the cheapest collective on a TPU torus),
-merging each partial attention with an online-softmax update. Compute on
+merging each hop's partial attention with a logsumexp combine. Compute on
 step t overlaps the permute for step t+1 under XLA's async collectives.
+
+v2 design (this file):
+
+- each hop runs the pallas flash kernel (``ops.attention.flash_attention_lse``)
+  over the local q shard and the circulating k/v shard — per-hop memory is
+  O(block), never the [S_local, S_local] score matrix, and the matmuls ride
+  the MXU in the input dtype (bf16) with f32 accumulation;
+- hops merge by their logsumexp: o ← o·e^{lse−lse'} + o_t·e^{lse_t−lse'},
+  lse' = logaddexp(lse, lse_t) — mathematically identical to one softmax
+  over the full row;
+- causal masking uses explicit global position ids per hop, so the same
+  kernel handles **zigzag ordering**: device i holds sequence chunks i and
+  2n−1−i (of 2n total), which balances causal work across the ring — with
+  naive contiguous sharding rank n−1 attends to everything while rank 0
+  attends only to itself.
 
 This is the piece of the stack the reference has no analog for: its
 operator hands out ranks and the user's MPI program owns the math
 (SURVEY.md §2.4 — TP/SP/ring-attention "absent, delegated to user
 programs"). Here the framework owns it.
 
-Differentiable end-to-end: the ring is a ``lax.scan`` of pure jnp ops
-plus ``ppermute`` (which has a transpose rule), so reverse-mode autodiff
-replays the ring backwards without custom VJP code.
+Differentiable end-to-end: the ring is a ``lax.scan`` of flash calls
+(custom VJP, lse cotangent included) plus ``ppermute`` (which has a
+transpose rule), so reverse-mode autodiff replays the ring backwards
+without custom ring-level VJP code.
 """
 
 from __future__ import annotations
@@ -23,11 +39,106 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import DP, FSDP, SP, TP
+from .attention import flash_attention_lse
 
 NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Zigzag layout
+# ---------------------------------------------------------------------------
+
+
+def zigzag_indices(seq_len: int, n: int) -> np.ndarray:
+    """Permutation p with ``x_zig = x[..., p]``: chunk pairs (i, 2n−1−i)
+    land on device i. Split the sequence into 2n chunks; device i's shard
+    is [chunk_i ; chunk_{2n−1−i}], so every device holds one early and one
+    late chunk and causal work is balanced across the ring (each device
+    sees the same number of visible (q, k) chunk pairs ±1)."""
+    if seq_len % (2 * n):
+        raise ValueError(f"seq_len {seq_len} not divisible by 2*{n}")
+    chunk = seq_len // (2 * n)
+    ids = np.arange(seq_len).reshape(2 * n, chunk)
+    order = []
+    for i in range(n):
+        order += [i, 2 * n - 1 - i]
+    return ids[order].reshape(-1)
+
+
+def zigzag_inverse(seq_len: int, n: int) -> np.ndarray:
+    """Inverse permutation: ``x == x_zig[..., zigzag_inverse(S, n)]``."""
+    perm = zigzag_indices(seq_len, n)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(seq_len)
+    return inv
+
+
+def _shard_ids(idx, n: int, s_loc: int, zigzag: bool):
+    """Global sequence positions of the s_loc rows held by ring rank
+    ``idx`` (traced). Contiguous layout: one run; zigzag: two half-chunk
+    runs (idx and 2n−1−idx)."""
+    if not zigzag:
+        return idx * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+    half = s_loc // 2
+    a = idx * half + jnp.arange(half, dtype=jnp.int32)
+    b = (2 * n - 1 - idx) * half + jnp.arange(half, dtype=jnp.int32)
+    return jnp.concatenate([a, b])
+
+
+# ---------------------------------------------------------------------------
+# Per-hop partials
+# ---------------------------------------------------------------------------
+
+
+def _dense_partial(q, k, v, row, col, causal, sm_scale):
+    """Oracle per-hop partial attention: dense f32 scores (O(S_local²)
+    memory). Kept as the reference implementation the flash path is tested
+    against and as a debug fallback (``impl="dense"``)."""
+    b, h, s_loc, d = q.shape
+    h_kv = k.shape[1]
+    groups = h // h_kv
+    qf = q.astype(jnp.float32).reshape(b, h_kv, groups, s_loc, d)
+    s = jnp.einsum(
+        "bhgqd,bhkd->bhgqk", qf, k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale
+    if causal:
+        mask = col[None, None, None, None, :] <= row[None, None, None, :, None]
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.maximum(m, NEG_INF)  # all-masked rows: keep finite
+    p = jnp.exp(s - m)
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum(
+        "bhgqk,bhkd->bhgqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) / jnp.where(l > 0.0, l, 1.0)
+    lse = jnp.where(l > 0.0, m + jnp.log(jnp.where(l > 0.0, l, 1.0)), NEG_INF)
+    return (
+        o.reshape(b, h, s_loc, d),
+        lse.reshape(b, h, s_loc),
+    )
+
+
+def _flash_partial(q, k, v, row, col, causal, sm_scale):
+    if causal:
+        out, lse = flash_attention_lse(
+            q, k, v, row_ids=row, col_ids=col, sm_scale=sm_scale
+        )
+    else:
+        out, lse = flash_attention_lse(q, k, v, sm_scale=sm_scale)
+    return out.astype(jnp.float32), lse
+
+
+# ---------------------------------------------------------------------------
+# The ring
+# ---------------------------------------------------------------------------
 
 
 def ring_attention(
@@ -36,75 +147,60 @@ def ring_attention(
     *,
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    zigzag: bool = False,
+    impl: str = "flash",
 ):
     """Per-shard ring attention — call inside shard_map/pmap.
 
     q, k, v: local shards [B, H, S_local, D]; the global sequence is the
-    concatenation over ``axis_name`` (device i holds rows
-    [i*S_local, (i+1)*S_local)). Returns the local output shard.
-
-    Causal note: plain ring order leaves later-ranked devices doing more
-    unmasked work than earlier ones (a known imbalance; zigzag ordering
-    halves it). Masked-out steps still circulate k/v but contribute no
-    matmul results.
+    concatenation over ``axis_name``. Contiguous layout: device i holds
+    rows [i·S_local, (i+1)·S_local). ``zigzag=True``: device i holds
+    chunks i and 2n−1−i of 2n (callers permute the global sequence with
+    ``zigzag_indices`` first) — balances causal work across ranks.
+    Returns the local output shard in the layout of q.
     """
+    if impl not in ("flash", "dense"):
+        raise ValueError(f"impl must be 'flash' or 'dense', got {impl!r}")
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
-
     b, h, s_loc, d = q.shape
-    h_kv = k.shape[1]
-    if h % h_kv:
-        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
-    groups = h // h_kv
-    # GQA: group the q heads so only the h_kv-head k/v shards circulate the
-    # ring (1/groups of the ICI traffic of expanding kv up front).
-    qf = q.astype(jnp.float32).reshape(b, h_kv, groups, s_loc, d)
-    row = my * s_loc + jnp.arange(s_loc)  # global row ids of the local q shard
+    if h % k.shape[1]:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {k.shape[1]}")
+    if zigzag and s_loc % 2:
+        raise ValueError(f"zigzag needs an even local seq, got {s_loc}")
+
+    partial_fn = _flash_partial if impl == "flash" else _dense_partial
+    row = _shard_ids(my, n, s_loc, zigzag)
 
     def step(carry, t):
-        acc, m, l, k_cur, v_cur = carry
+        o, lse, k_cur, v_cur = carry
         # k_cur originated on device (my - t) mod n.
         src = jax.lax.rem(my - t + n, n)
-        col = src * s_loc + jnp.arange(s_loc)  # global col ids of k_cur
-
-        s = jnp.einsum(
-            "bhgqd,bhkd->bhgqk", qf, k_cur.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        ) * sm_scale
-        if causal:
-            mask = col[None, None, None, None, :] <= row[None, None, None, :, None]
-            s = jnp.where(mask, s, NEG_INF)
-
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        if causal:
-            p = jnp.where(mask, p, 0.0)
-        correction = jnp.exp(m - m_new)
-        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * correction + jnp.einsum(
-            "bhgqk,bhkd->bhgqd", p, v_cur.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
+        col = _shard_ids(src, n, s_loc, zigzag)
+        o_t, lse_t = partial_fn(q, k_cur, v_cur, row, col, causal, sm_scale)
+        # logsumexp merge: exact softmax over all columns seen so far.
+        lse_new = jnp.logaddexp(lse, lse_t)
+        o_new = (
+            o * jnp.exp(lse - lse_new)[..., None]
+            + o_t * jnp.exp(lse_t - lse_new)[..., None]
         )
-
         perm = [(i, (i + 1) % n) for i in range(n)]
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (acc_new, m_new, l_new, k_nxt, v_nxt), None
+        return (o_new, lse_new, k_nxt, v_nxt), None
 
-    # Inits derived from qf so they carry the same varying-axes type as the
+    # Inits derived from q so they carry the same varying-axes type as the
     # loop outputs under shard_map's vma checking.
     init = (
-        jnp.zeros_like(qf),
-        jnp.full_like(qf[..., :1], NEG_INF),
-        jnp.zeros_like(qf[..., :1]),
+        jnp.zeros_like(q, dtype=jnp.float32),
+        jnp.full_like(q[..., 0], NEG_INF, dtype=jnp.float32),
         k,
         v,
     )
-    (acc, _, l, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
-    out = acc / jnp.where(l > 0.0, l, 1.0)
-    return out.reshape(b, h, s_loc, d).astype(q.dtype)
+    (o, _, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
+    return o.astype(q.dtype)
 
 
 def ring_spec(mesh, axis: str = SP, n_heads: Optional[int] = None):
@@ -129,6 +225,8 @@ def ring_attention_shard_mapped(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     axis: str = SP,
+    zigzag: bool = False,
+    impl: str = "flash",
 ):
     """shard_map the per-shard ring kernel over the mesh — composable
     inside a larger jitted computation (models call this directly).
@@ -148,11 +246,16 @@ def ring_attention_shard_mapped(
     kv_spec = ring_spec(mesh, axis, hkv if tp_heads else None)
     fn = shard_map(
         lambda a, b, c: ring_attention(
-            a, b, c, axis, causal=causal, sm_scale=sm_scale
+            a, b, c, axis, causal=causal, sm_scale=sm_scale,
+            zigzag=zigzag, impl=impl,
         ),
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec),
         out_specs=q_spec,
+        # pallas-in-shard_map trips jax's vma tracking in interpret mode
+        # (dynamic_slice "varying manual axes" — jax suggests this exact
+        # workaround); correctness is covered by the dense-oracle tests.
+        check_vma=False,
     )
     return fn(q, k, v)
 
@@ -164,13 +267,17 @@ def ring_attention_sharded(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     axis: str = SP,
+    zigzag: bool = False,
+    impl: str = "flash",
 ):
     """Global-view ring attention: jit + placement around
     ``ring_attention_shard_mapped`` for standalone use.
 
     Inputs are global [B, H, S, D] arrays (S divisible by the sp axis
     size); sharding constraints place them before the shard_map so XLA
-    does not gather the sequence axis.
+    does not gather the sequence axis. With ``zigzag=True`` the inputs
+    must already be in zigzag order (``x[..., zigzag_indices(S, n), :]``);
+    the output comes back in the same order.
     """
     if axis not in mesh.axis_names:
         return None  # caller should fall back to dense attention
@@ -180,7 +287,8 @@ def ring_attention_sharded(
     def run(q, k, v):
         q_, k_, v_ = (jax.lax.with_sharding_constraint(x, spec) for x in (q, k, v))
         return ring_attention_shard_mapped(
-            q_, k_, v_, mesh, causal=causal, sm_scale=sm_scale, axis=axis
+            q_, k_, v_, mesh, causal=causal, sm_scale=sm_scale, axis=axis,
+            zigzag=zigzag, impl=impl,
         )
 
     with mesh:

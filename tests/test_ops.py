@@ -14,8 +14,11 @@ import pytest
 from mpi_operator_tpu.ops import (
     attention_reference,
     flash_attention,
+    flash_attention_lse,
     ring_attention,
     ring_attention_sharded,
+    zigzag_indices,
+    zigzag_inverse,
 )
 from mpi_operator_tpu.parallel import create_mesh
 
@@ -126,6 +129,154 @@ class TestFlashAttention:
             flash_attention(q, k, v)
 
 
+class TestFlashAttentionLse:
+    """The (out, lse) variant ring attention builds its hop merge on."""
+
+    def test_lse_matches_dense_logsumexp(self):
+        q, k, v = _qkv(b=1, h=2, sq=128, d=64)
+        out, lse = flash_attention_lse(q, k, v)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (q.shape[-1] ** -0.5)
+        np.testing.assert_allclose(
+            lse, jax.nn.logsumexp(s, axis=-1), atol=2e-5, rtol=2e-5
+        )
+        np.testing.assert_allclose(
+            out, attention_reference(q, k, v), atol=2e-5, rtol=2e-5
+        )
+
+    def test_explicit_ids_reproduce_causal(self):
+        q, k, v = _qkv(b=1, h=2, sq=128, d=64)
+        ids = jnp.arange(128, dtype=jnp.int32)
+        out, _ = flash_attention_lse(q, k, v, row_ids=ids, col_ids=ids)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_fully_masked_rows_are_zero_weight(self):
+        # All columns later than every row: out = 0, lse = -inf sentinel,
+        # so a merge treats the partial as contributing nothing.
+        q, k, v = _qkv(b=1, h=1, sq=64, d=32)
+        ids = jnp.arange(64, dtype=jnp.int32)
+        out, lse = flash_attention_lse(q, k, v, row_ids=ids, col_ids=ids + 64)
+        assert float(jnp.max(jnp.abs(out))) == 0.0
+        assert float(jnp.max(lse)) <= -1e29
+
+    def test_split_kv_merge_equals_full_attention(self):
+        # The exact merge ring attention performs, two hops' worth.
+        q, k, v = _qkv(b=1, h=2, sq=128, d=64)
+        o1, l1 = flash_attention_lse(q, k[:, :, :64], v[:, :, :64])
+        o2, l2 = flash_attention_lse(q, k[:, :, 64:], v[:, :, 64:])
+        lt = jnp.logaddexp(l1, l2)
+        merged = (
+            o1 * jnp.exp(l1 - lt)[..., None] + o2 * jnp.exp(l2 - lt)[..., None]
+        )
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(merged, ref, atol=2e-5, rtol=2e-5)
+
+    def test_lse_cotangent_flows(self):
+        # Gradient through a merge uses d(lse) — must match dense autodiff.
+        q, k, v = _qkv(b=1, h=1, sq=64, d=32)
+        ids = jnp.arange(64, dtype=jnp.int32)
+
+        def loss_split(q, k, v):
+            o1, l1 = flash_attention_lse(
+                q, k[:, :, :32], v[:, :, :32], row_ids=ids, col_ids=ids[:32]
+            )
+            o2, l2 = flash_attention_lse(
+                q, k[:, :, 32:], v[:, :, 32:], row_ids=ids, col_ids=ids[32:]
+            )
+            lt = jnp.logaddexp(l1, l2)
+            o = o1 * jnp.exp(l1 - lt)[..., None] + o2 * jnp.exp(l2 - lt)[..., None]
+            return jnp.sum(o ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+        g_split = jax.grad(loss_split, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for got, want, name in zip(g_split, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                got, want, atol=5e-4, rtol=1e-3, err_msg=f"d{name} mismatch"
+            )
+
+
+class TestZigzag:
+    def test_permutation_roundtrip(self):
+        perm = zigzag_indices(64, 4)
+        inv = zigzag_inverse(64, 4)
+        np.testing.assert_array_equal(perm[inv], np.arange(64))
+        np.testing.assert_array_equal(inv[perm], np.arange(64))
+
+    def test_chunks_pair_early_with_late(self):
+        # Device i's shard is [chunk_i ; chunk_{2n-1-i}].
+        perm = zigzag_indices(16, 2)  # 4 chunks of 4
+        np.testing.assert_array_equal(
+            perm, [0, 1, 2, 3, 12, 13, 14, 15, 4, 5, 6, 7, 8, 9, 10, 11]
+        )
+
+    def test_rejects_indivisible(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            zigzag_indices(10, 4)
+
+    def test_zigzag_ring_matches_dense(self):
+        mesh = create_mesh(sp=8)
+        q, k, v = _qkv(b=2, h=2, sq=64, d=32)
+        perm = zigzag_indices(64, 8)
+        inv = zigzag_inverse(64, 8)
+        out = ring_attention_sharded(
+            q[:, :, perm], k[:, :, perm], v[:, :, perm],
+            mesh, causal=True, zigzag=True,
+        )
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out[:, :, inv], ref, atol=1e-5, rtol=1e-5)
+
+    def test_zigzag_dense_impl_matches(self):
+        mesh = create_mesh(sp=8)
+        q, k, v = _qkv(b=1, h=2, sq=64, d=32)
+        perm = zigzag_indices(64, 8)
+        a = ring_attention_sharded(
+            q[:, :, perm], k[:, :, perm], v[:, :, perm],
+            mesh, causal=True, zigzag=True, impl="dense",
+        )
+        b = ring_attention_sharded(
+            q[:, :, perm], k[:, :, perm], v[:, :, perm],
+            mesh, causal=True, zigzag=True, impl="flash",
+        )
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+    def test_zigzag_gradients(self):
+        mesh = create_mesh(sp=8)
+        q, k, v = _qkv(b=1, h=1, sq=64, d=16)
+        perm = zigzag_indices(64, 8)
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        spec = P(None, None, "sp", None)
+        fn = shard_map(
+            lambda a, b, c: ring_attention(a, b, c, "sp", causal=True, zigzag=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+
+        def loss_zig(q, k, v):
+            return jnp.sum(fn(q[:, :, perm], k[:, :, perm], v[:, :, perm]) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+        with mesh:
+            g_zig = jax.jit(jax.grad(loss_zig, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for got, want, name in zip(g_zig, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                got, want, atol=1e-4, rtol=1e-3, err_msg=f"d{name} mismatch"
+            )
+
+    def test_rejects_odd_local_seq(self):
+        mesh = create_mesh(sp=8)
+        q, k, v = _qkv(b=1, h=1, sq=8, d=16)  # s_loc = 1, odd
+        with pytest.raises(ValueError, match="even local seq"):
+            ring_attention_sharded(q, k, v, mesh, causal=True, zigzag=True)
+
+
 class TestRingAttention:
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_dense_over_8_shards(self, causal):
@@ -152,6 +303,7 @@ class TestRingAttention:
         fn = shard_map(
             lambda a, b, c: ring_attention(a, b, c, "sp", causal=True),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,  # pallas-in-shard_map interpret-mode limitation
         )
 
         def loss_ring(q, k, v):
