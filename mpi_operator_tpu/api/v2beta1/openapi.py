@@ -1,0 +1,444 @@
+"""Structural OpenAPI v3 schema for the TPUJob CRD.
+
+controller-gen analog: the reference generates its CRD schema from Go
+types (`make crd` → v2/crd/kubeflow.org_mpijobs.yaml, Makefile:148-150),
+including the full core/v1 PodTemplateSpec schema (~488 KB). Here the
+schema is built from these Python dicts: `hack/gen_manifests.py` wraps
+them in the CRD envelope, and the in-memory apiserver enforces them at
+admission (api/schema.py) the way a real apiserver enforces the CRD —
+malformed pod templates are rejected at create time, not at pod-creation
+time.
+
+The pod template schema is a *trimmed but structural* subset of core/v1:
+every field the operator's builders consume plus the common pod surface
+(containers, env, resources, volumes, scheduling). Exotic subtrees
+(probes, securityContext, affinity, volume sources) stay open via
+``x-kubernetes-preserve-unknown-fields`` — present and typed as objects,
+contents unvalidated, exactly how a trimmed controller-gen schema would
+mark them.
+"""
+
+from __future__ import annotations
+
+from . import types
+
+# DNS-1123 label (container/port/volume names).
+DNS1123 = r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$"
+
+
+def _str(desc: str = "", **kw) -> dict:
+    d = {"type": "string"}
+    if desc:
+        d["description"] = desc
+    d.update(kw)
+    return d
+
+
+def _int(desc: str = "", minimum=None, maximum=None) -> dict:
+    d: dict = {"type": "integer", "format": "int32"}
+    if desc:
+        d["description"] = desc
+    if minimum is not None:
+        d["minimum"] = minimum
+    if maximum is not None:
+        d["maximum"] = maximum
+    return d
+
+
+def _bool(desc: str = "") -> dict:
+    d = {"type": "boolean"}
+    if desc:
+        d["description"] = desc
+    return d
+
+
+def _str_array() -> dict:
+    return {"type": "array", "items": {"type": "string"}}
+
+
+def _open_object(desc: str = "") -> dict:
+    d = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+    if desc:
+        d["description"] = desc
+    return d
+
+
+def _string_map(desc: str = "") -> dict:
+    d = {"type": "object", "additionalProperties": {"type": "string"}}
+    if desc:
+        d["description"] = desc
+    return d
+
+
+def quantity_map(desc: str = "") -> dict:
+    """resources.limits / resources.requests: quantities are int-or-string
+    (\"2\", \"500m\", \"1Gi\", 4)."""
+    d = {
+        "type": "object",
+        "additionalProperties": {"x-kubernetes-int-or-string": True},
+    }
+    if desc:
+        d["description"] = desc
+    return d
+
+
+def container_schema() -> dict:
+    return {
+        "type": "object",
+        "required": ["name"],
+        "properties": {
+            "name": _str("Container name (DNS label).", pattern=DNS1123),
+            "image": _str("Container image."),
+            "command": _str_array(),
+            "args": _str_array(),
+            "workingDir": _str(),
+            "imagePullPolicy": _str(
+                enum=["Always", "IfNotPresent", "Never"]
+            ),
+            "env": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "required": ["name"],
+                    "properties": {
+                        "name": _str("Environment variable name."),
+                        "value": _str(),
+                        "valueFrom": _open_object(
+                            "fieldRef / secretKeyRef / configMapKeyRef source."
+                        ),
+                    },
+                },
+            },
+            "envFrom": {
+                "type": "array",
+                "items": _open_object("configMapRef / secretRef bulk import."),
+            },
+            "ports": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "required": ["containerPort"],
+                    "properties": {
+                        "name": _str(pattern=DNS1123),
+                        "containerPort": _int(minimum=1, maximum=65535),
+                        "hostPort": _int(minimum=1, maximum=65535),
+                        "protocol": _str(enum=["TCP", "UDP", "SCTP"]),
+                    },
+                },
+            },
+            "resources": {
+                "type": "object",
+                "description": (
+                    "Compute resources; google.com/tpu limits are injected "
+                    "by the operator when absent."
+                ),
+                "properties": {
+                    "limits": quantity_map(),
+                    "requests": quantity_map(),
+                },
+            },
+            "volumeMounts": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "required": ["name", "mountPath"],
+                    "properties": {
+                        "name": _str(),
+                        "mountPath": _str(),
+                        "subPath": _str(),
+                        "readOnly": _bool(),
+                    },
+                },
+            },
+            "securityContext": _open_object(),
+            "lifecycle": _open_object(),
+            "livenessProbe": _open_object(),
+            "readinessProbe": _open_object(),
+            "startupProbe": _open_object(),
+            "terminationMessagePath": _str(),
+            "terminationMessagePolicy": _str(
+                enum=["File", "FallbackToLogsOnError"]
+            ),
+            "stdin": _bool(),
+            "tty": _bool(),
+        },
+    }
+
+
+def pod_template_schema() -> dict:
+    """Trimmed core/v1 PodTemplateSpec (reference embeds the full schema,
+    v2/crd/kubeflow.org_mpijobs.yaml; this keeps the fields that matter
+    structural and leaves exotic subtrees open)."""
+    return {
+        "type": "object",
+        "description": "core/v1 PodTemplateSpec for the replica pods.",
+        "properties": {
+            "metadata": {
+                "type": "object",
+                "properties": {
+                    "labels": _string_map(),
+                    "annotations": _string_map(),
+                    "name": _str(),
+                    "namespace": _str(),
+                },
+            },
+            "spec": {
+                "type": "object",
+                "required": ["containers"],
+                "properties": {
+                    "containers": {
+                        "type": "array",
+                        "minItems": 1,
+                        "items": container_schema(),
+                    },
+                    "initContainers": {
+                        "type": "array",
+                        "items": container_schema(),
+                    },
+                    "volumes": {
+                        "type": "array",
+                        "items": {
+                            # name is structural; the volume *source* union
+                            # (30+ types in core/v1) stays open.
+                            "type": "object",
+                            "required": ["name"],
+                            "properties": {"name": _str(pattern=DNS1123)},
+                            "x-kubernetes-preserve-unknown-fields": True,
+                        },
+                    },
+                    "nodeSelector": _string_map(),
+                    "tolerations": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "properties": {
+                                "key": _str(),
+                                "operator": _str(enum=["Exists", "Equal"]),
+                                "value": _str(),
+                                "effect": _str(
+                                    enum=[
+                                        "NoSchedule",
+                                        "PreferNoSchedule",
+                                        "NoExecute",
+                                    ]
+                                ),
+                                "tolerationSeconds": {
+                                    "type": "integer",
+                                    "format": "int64",
+                                },
+                            },
+                        },
+                    },
+                    "affinity": _open_object(),
+                    "topologySpreadConstraints": {
+                        "type": "array",
+                        "items": _open_object(),
+                    },
+                    "schedulerName": _str(),
+                    "priorityClassName": _str(),
+                    "serviceAccountName": _str(),
+                    "automountServiceAccountToken": _bool(),
+                    "restartPolicy": _str(
+                        "Pod-level restart policy; the operator derives it "
+                        "from the ReplicaSpec when unset.",
+                        enum=["Always", "OnFailure", "Never"],
+                    ),
+                    "terminationGracePeriodSeconds": {
+                        "type": "integer",
+                        "format": "int64",
+                        "minimum": 0,
+                    },
+                    "activeDeadlineSeconds": {
+                        "type": "integer",
+                        "format": "int64",
+                        "minimum": 1,
+                    },
+                    "hostNetwork": _bool(),
+                    "hostPID": _bool(),
+                    "hostIPC": _bool(),
+                    "dnsPolicy": _str(
+                        enum=[
+                            "ClusterFirst",
+                            "ClusterFirstWithHostNet",
+                            "Default",
+                            "None",
+                        ]
+                    ),
+                    "securityContext": _open_object(),
+                    "imagePullSecrets": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "properties": {"name": _str()},
+                        },
+                    },
+                    "subdomain": _str(),
+                    "hostname": _str(),
+                },
+            },
+        },
+    }
+
+
+def replica_spec_schema(role: str) -> dict:
+    return {
+        "type": "object",
+        "description": f"{role} replica group.",
+        "properties": {
+            "replicas": _int(
+                "Number of replicas. For Worker this is normally derived "
+                "from spec.tpu and may be omitted.",
+                minimum=0,
+            ),
+            "restartPolicy": _str(
+                "Restart policy for replica pods.",
+                enum=[types.RESTART_POLICY_NEVER, types.RESTART_POLICY_ON_FAILURE],
+            ),
+            "template": pod_template_schema(),
+        },
+    }
+
+
+def job_spec_schema() -> dict:
+    return {
+        "type": "object",
+        "required": ["tpuReplicaSpecs"],
+        "properties": {
+            "tpu": {
+                "type": "object",
+                "description": (
+                    "The TPU slice shape this job trains on. Worker count and "
+                    "chips-per-pod are derived from acceleratorType/topology."
+                ),
+                "properties": {
+                    "acceleratorType": _str(
+                        "TPU slice type, <generation>-<chips>, e.g. v5e-16.",
+                        pattern=r"^v[0-9]+[a-z]*-[0-9]+$",
+                    ),
+                    "topology": _str(
+                        "Optional explicit chip topology, e.g. 4x4 or 2x2x4.",
+                        pattern=r"^[0-9]+(x[0-9]+)*$",
+                    ),
+                    "numSlices": _int(
+                        "Number of pod slices (>1 = multislice over DCN).",
+                        minimum=1,
+                    ),
+                    "runtimeVersion": _str("TPU VM runtime version label."),
+                },
+            },
+            "jaxDistribution": {
+                "type": "object",
+                "description": (
+                    "Rendezvous wiring for jax.distributed.initialize. "
+                    "Replaces the reference operator's SSH bootstrap: the only "
+                    "shared state is worker-0's coordinator address."
+                ),
+                "properties": {
+                    "coordinatorPort": _int(
+                        "Coordinator port on worker 0.", minimum=1, maximum=65535
+                    ),
+                    "heartbeatTimeoutSeconds": _int(
+                        "jax.distributed heartbeat timeout.", minimum=1
+                    ),
+                },
+            },
+            "runPolicy": {
+                "type": "object",
+                "description": "Policies for job lifetime and cleanup.",
+                "properties": {
+                    "cleanPodPolicy": _str(
+                        "Which worker pods to delete once the job finishes.",
+                        enum=[
+                            types.CLEAN_POD_POLICY_NONE,
+                            types.CLEAN_POD_POLICY_RUNNING,
+                            types.CLEAN_POD_POLICY_ALL,
+                        ],
+                    ),
+                    "ttlSecondsAfterFinished": _int(minimum=0),
+                    "activeDeadlineSeconds": _int(minimum=0),
+                    "backoffLimit": _int(minimum=0),
+                    "suspend": {
+                        "type": "boolean",
+                        "description": "Suspend gates worker/launcher creation.",
+                    },
+                    "schedulingPolicy": {
+                        "type": "object",
+                        "properties": {
+                            "minAvailable": _int(minimum=0),
+                            "queue": _str(),
+                            "priorityClass": _str(),
+                        },
+                    },
+                },
+            },
+            "tpuReplicaSpecs": {
+                "type": "object",
+                "required": [types.REPLICA_TYPE_WORKER],
+                "properties": {
+                    types.REPLICA_TYPE_LAUNCHER: replica_spec_schema("Launcher"),
+                    types.REPLICA_TYPE_WORKER: replica_spec_schema("Worker"),
+                },
+            },
+        },
+    }
+
+
+def job_status_schema() -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "conditions": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "required": ["type", "status"],
+                    "properties": {
+                        "type": _str(
+                            enum=[
+                                types.JOB_CREATED,
+                                types.JOB_RUNNING,
+                                types.JOB_RESTARTING,
+                                types.JOB_SUSPENDED,
+                                types.JOB_SUCCEEDED,
+                                types.JOB_FAILED,
+                            ]
+                        ),
+                        "status": _str(enum=["True", "False", "Unknown"]),
+                        "reason": _str(),
+                        "message": _str(),
+                        "lastUpdateTime": {"type": "number"},
+                        "lastTransitionTime": {"type": "number"},
+                    },
+                },
+            },
+            "replicaStatuses": {
+                "type": "object",
+                "additionalProperties": {
+                    "type": "object",
+                    "properties": {
+                        "active": _int(minimum=0),
+                        "succeeded": _int(minimum=0),
+                        "failed": _int(minimum=0),
+                        "restarts": _int(minimum=0),
+                    },
+                },
+            },
+            "startTime": {"type": "number"},
+            "completionTime": {"type": "number"},
+            "lastReconcileTime": {"type": "number"},
+        },
+    }
+
+
+def tpujob_schema() -> dict:
+    """The complete openAPIV3Schema for the TPUJob CRD version entry."""
+    return {
+        "type": "object",
+        "properties": {
+            "apiVersion": _str(),
+            "kind": _str(),
+            "metadata": {"type": "object"},
+            "spec": job_spec_schema(),
+            "status": job_status_schema(),
+        },
+    }

@@ -202,11 +202,15 @@ def run(argv=None) -> int:
                         file=sys.stderr,
                     )
                     return 1
-                from ..runtime.apiserver import AlreadyExistsError
+                from ..runtime.apiserver import AlreadyExistsError, InvalidError
 
                 try:
                     created = api.create("tpujobs", doc)
                     verb = "applied"
+                except InvalidError as exc:
+                    # Schema admission (CRD analog) rejected the manifest.
+                    print(f"error: {path}: {exc}", file=sys.stderr)
+                    return 1
                 except AlreadyExistsError:
                     # Cluster state persists across operator runs (unlike
                     # the memory backend): adopt the existing job.

@@ -598,6 +598,15 @@ def flash_attention_lse(
         raise ValueError(f"expected [B, H, S, D] inputs, got rank {q.ndim}")
     if (row_ids is None) != (col_ids is None):
         raise ValueError("row_ids and col_ids must be given together")
+    if row_ids is not None:
+        if row_ids.shape != (q.shape[2],):
+            raise ValueError(
+                f"row_ids shape {row_ids.shape} != (q_len,) = ({q.shape[2]},)"
+            )
+        if col_ids.shape != (k.shape[2],):
+            raise ValueError(
+                f"col_ids shape {col_ids.shape} != (kv_len,) = ({k.shape[2]},)"
+            )
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if interpret is None:

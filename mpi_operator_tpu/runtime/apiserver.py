@@ -202,9 +202,33 @@ class InMemoryAPIServer:
 
     # -- CRUD ------------------------------------------------------------
 
+    def _admit(self, resource: str, obj: dict) -> dict:
+        """CRD structural-schema admission (real-apiserver analog): TPUJob
+        writes are validated against the generated openAPIV3Schema — a
+        malformed pod template fails here, at create/update time, not
+        later at pod-creation time — and unknown fields are pruned the
+        way a real apiserver prunes them (typos never reach storage)."""
+        if resource != "tpujobs":
+            return obj
+        from ..api.schema import (
+            prune,
+            tpujob_openapi_schema,
+            validate_tpujob_object,
+        )
+
+        errors = validate_tpujob_object(obj)
+        if errors:
+            name = self._key(obj)[1]
+            shown = "; ".join(errors[:5])
+            if len(errors) > 5:
+                shown += f" (+{len(errors) - 5} more)"
+            raise InvalidError(resource, name, shown)
+        return prune(obj, tpujob_openapi_schema())
+
     def create(self, resource: str, obj: dict) -> dict:
         self._check_resource(resource)
         obj = copy.deepcopy(obj)
+        obj = self._admit(resource, obj)
         with self._lock:
             key = self._key(obj)
             if not key[1]:
@@ -252,6 +276,8 @@ class InMemoryAPIServer:
     def _update(self, resource: str, obj: dict, *, status_only: bool) -> dict:
         self._check_resource(resource)
         obj = copy.deepcopy(obj)
+        if not status_only:
+            obj = self._admit(resource, obj)
         with self._lock:
             key = self._key(obj)
             current = self._store[resource].get(key)

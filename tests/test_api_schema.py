@@ -1,0 +1,203 @@
+"""CRD structural-schema admission: the generated openAPIV3Schema must
+reject malformed pod templates at create time (real-apiserver analog for
+the reference's controller-gen CRD, v2/crd/kubeflow.org_mpijobs.yaml),
+and unknown fields must prune — not error — outside preserve-unknown
+subtrees.
+"""
+
+import pytest
+
+from mpi_operator_tpu.api.schema import (
+    prune,
+    validate_schema,
+    validate_tpujob_object,
+)
+from mpi_operator_tpu.api.v2beta1.openapi import pod_template_schema
+from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer, InvalidError
+
+
+def job_dict(template=None) -> dict:
+    worker: dict = {"replicas": 2}
+    if template is not None:
+        worker["template"] = template
+    return {
+        "apiVersion": "kubeflow.org/v2beta1",
+        "kind": "TPUJob",
+        "metadata": {"name": "j", "namespace": "default"},
+        "spec": {
+            "tpu": {"acceleratorType": "v5e-8"},
+            "tpuReplicaSpecs": {"Worker": worker},
+        },
+    }
+
+
+def good_template() -> dict:
+    return {
+        "spec": {
+            "containers": [
+                {
+                    "name": "worker",
+                    "image": "img:latest",
+                    "command": ["python", "train.py"],
+                    "env": [{"name": "FOO", "value": "bar"}],
+                    "resources": {"limits": {"google.com/tpu": 4}},
+                    "ports": [{"containerPort": 8471, "protocol": "TCP"}],
+                }
+            ],
+            "volumes": [{"name": "data", "emptyDir": {}}],
+            "nodeSelector": {"cloud.google.com/gke-tpu-topology": "2x4"},
+        }
+    }
+
+
+class TestTpujobSchema:
+    def test_valid_job_admits(self):
+        assert validate_tpujob_object(job_dict(good_template())) == []
+
+    def test_missing_replica_specs_rejected(self):
+        job = job_dict()
+        del job["spec"]["tpuReplicaSpecs"]
+        errs = validate_tpujob_object(job)
+        assert any("tpuReplicaSpecs" in e for e in errs)
+
+    def test_template_must_have_containers(self):
+        errs = validate_tpujob_object(job_dict({"spec": {}}))
+        assert any("containers" in e for e in errs)
+
+    def test_empty_containers_rejected(self):
+        errs = validate_tpujob_object(job_dict({"spec": {"containers": []}}))
+        assert any("at least 1" in e for e in errs)
+
+    def test_container_missing_name_rejected(self):
+        errs = validate_tpujob_object(
+            job_dict({"spec": {"containers": [{"image": "img"}]}})
+        )
+        assert any("missing required field 'name'" in e for e in errs)
+
+    def test_containers_as_string_rejected(self):
+        errs = validate_tpujob_object(
+            job_dict({"spec": {"containers": "worker"}})
+        )
+        assert any("expected array" in e for e in errs)
+
+    def test_env_value_must_be_string(self):
+        tpl = good_template()
+        tpl["spec"]["containers"][0]["env"] = [{"name": "N", "value": 3}]
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any("env[0].value" in e and "expected string" in e for e in errs)
+
+    def test_bad_container_port_rejected(self):
+        tpl = good_template()
+        tpl["spec"]["containers"][0]["ports"] = [{"containerPort": 99999}]
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any("above maximum" in e for e in errs)
+
+    def test_resource_quantities_int_or_string(self):
+        tpl = good_template()
+        tpl["spec"]["containers"][0]["resources"] = {
+            "limits": {"cpu": "500m", "memory": "1Gi", "google.com/tpu": 4}
+        }
+        assert validate_tpujob_object(job_dict(tpl)) == []
+        tpl["spec"]["containers"][0]["resources"] = {"limits": {"cpu": 1.5}}
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any("integer or string" in e for e in errs)
+
+    def test_bad_restart_policy_enum(self):
+        tpl = good_template()
+        tpl["spec"]["restartPolicy"] = "WheneverConvenient"
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any("not one of" in e for e in errs)
+
+    def test_volume_requires_name_but_source_is_open(self):
+        tpl = good_template()
+        tpl["spec"]["volumes"] = [{"hostPath": {"path": "/x"}}]
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any("missing required field 'name'" in e for e in errs)
+
+    def test_accelerator_type_pattern(self):
+        job = job_dict(good_template())
+        job["spec"]["tpu"]["acceleratorType"] = "gpu-a100"
+        errs = validate_tpujob_object(job)
+        assert any("does not match" in e for e in errs)
+
+
+class TestPruneSemantics:
+    def test_unknown_fields_prune_not_error(self):
+        tpl = good_template()
+        tpl["spec"]["madeUpField"] = {"x": 1}
+        assert validate_tpujob_object(job_dict(tpl)) == []
+        pruned = prune(tpl, pod_template_schema())
+        assert "madeUpField" not in pruned["spec"]
+        assert pruned["spec"]["containers"] == tpl["spec"]["containers"]
+
+    def test_preserved_subtrees_keep_unknowns(self):
+        tpl = good_template()
+        tpl["spec"]["containers"][0]["securityContext"] = {"runAsUser": 1000}
+        tpl["spec"]["volumes"][0]["emptyDir"] = {"medium": "Memory"}
+        pruned = prune(tpl, pod_template_schema())
+        sc = pruned["spec"]["containers"][0]["securityContext"]
+        assert sc == {"runAsUser": 1000}
+        assert pruned["spec"]["volumes"][0]["emptyDir"] == {"medium": "Memory"}
+
+    def test_prune_does_not_mutate_input(self):
+        tpl = good_template()
+        tpl["spec"]["junk"] = True
+        prune(tpl, pod_template_schema())
+        assert "junk" in tpl["spec"]
+
+    def test_validate_scalar_types(self):
+        assert validate_schema(True, {"type": "boolean"}) == []
+        assert validate_schema(1, {"type": "boolean"}) != []
+        assert validate_schema(True, {"type": "integer"}) != []
+        assert validate_schema(1.5, {"type": "number"}) == []
+
+
+class TestApiserverAdmission:
+    """The in-memory apiserver enforces the schema like a real cluster."""
+
+    def test_create_rejects_malformed_template(self):
+        api = InMemoryAPIServer()
+        with pytest.raises(InvalidError, match="containers"):
+            api.create("tpujobs", job_dict({"spec": {"containers": "nope"}}))
+
+    def test_create_admits_valid_job(self):
+        api = InMemoryAPIServer()
+        created = api.create("tpujobs", job_dict(good_template()))
+        assert created["metadata"]["uid"]
+
+    def test_update_rejects_regression(self):
+        api = InMemoryAPIServer()
+        created = api.create("tpujobs", job_dict(good_template()))
+        created["spec"]["tpuReplicaSpecs"]["Worker"]["template"] = {
+            "spec": {"containers": [{"image": "img"}]}
+        }
+        with pytest.raises(InvalidError, match="name"):
+            api.update("tpujobs", created)
+
+    def test_status_subresource_not_schema_gated(self):
+        # Status writes come from the trusted controller; only spec writes
+        # pass admission (matches our subresource split).
+        api = InMemoryAPIServer()
+        created = api.create("tpujobs", job_dict(good_template()))
+        created["status"] = {"startTime": 1.0}
+        updated = api.update_status("tpujobs", created)
+        assert updated["status"]["startTime"] == 1.0
+
+    def test_create_prunes_typod_fields(self):
+        # Typos the schema doesn't know are dropped at storage, exactly
+        # like a real apiserver (not stored, not errored).
+        api = InMemoryAPIServer()
+        job = job_dict(good_template())
+        job["spec"]["tpuReplicaSpecs"]["Worker"]["template"]["spec"][
+            "containers"
+        ][0]["comand"] = ["oops"]
+        created = api.create("tpujobs", job)
+        container = created["spec"]["tpuReplicaSpecs"]["Worker"]["template"][
+            "spec"]["containers"][0]
+        assert "comand" not in container
+        assert container["command"] == ["python", "train.py"]
+
+    def test_non_tpujob_resources_unaffected(self):
+        api = InMemoryAPIServer()
+        api.create("pods", {"metadata": {"name": "p", "namespace": "d"},
+                            "spec": {"containers": "whatever"}})

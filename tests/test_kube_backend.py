@@ -148,7 +148,7 @@ class TestCrudOverHttp:
     def test_delete_cascades_via_owner_refs(self, kube):
         owner = kube.create("tpujobs", {
             "metadata": {"name": "j", "namespace": "default"},
-            "spec": {},
+            "spec": {"tpuReplicaSpecs": {"Worker": {}}},
         })
         kube.create("pods", {
             "metadata": {

@@ -194,11 +194,14 @@ class TestTypedClients:
 
     def test_tpujob_client_status_subresource(self):
         from mpi_operator_tpu.api.v2beta1 import TPUJob
+        from mpi_operator_tpu.api.v2beta1.types import ReplicaSpec
 
         api = InMemoryAPIServer()
         client = TPUJobClient(api)
         job = TPUJob()
         job.metadata.name = "j"
+        # schema admission requires tpuReplicaSpecs.Worker
+        job.spec.replica_specs["Worker"] = ReplicaSpec()
         created = client.tpujobs("default").create(job)
         created.status.start_time = 1.0
         updated = client.tpujobs("default").update_status(created)
