@@ -48,8 +48,20 @@ ENV_JOB_NAMESPACE = "TPUJOB_NAMESPACE"
 ENV_NUM_SLICES = "TPUJOB_NUM_SLICES"
 ENV_SLICE_ID = "TPUJOB_SLICE_ID"
 
+# Multislice (DCN) rendezvous: when numSlices > 1, libtpu's megascale
+# runtime forms the cross-slice transport from these variables — the same
+# contract GKE's JobSet TPU integration sets for its pods. Slice 0's host
+# 0 is the megascale coordinator (distinct from the jax.distributed
+# coordinator only in port); ICI stays within a slice, DCN carries the
+# cross-slice collectives.
+ENV_MEGASCALE_COORDINATOR_ADDRESS = "MEGASCALE_COORDINATOR_ADDRESS"
+ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+ENV_MEGASCALE_PORT = "MEGASCALE_PORT"
+
 # Rendezvous defaults.
 DEFAULT_COORDINATOR_PORT = 8476  # jax.distributed's conventional port
+DEFAULT_MEGASCALE_PORT = 8080  # libtpu megascale's conventional port
 DEFAULT_CLEAN_POD_POLICY = "None"
 
 # Elastic restart/rejoin (BASELINE.md milestone 5): every worker pod is

@@ -126,6 +126,22 @@ def _validate_spec(spec: TPUJobSpec, path: str) -> list[FieldError]:
                 "must be a valid port number",
             )
         )
+    elif spec.tpu.num_slices > 1:
+        # Multislice worker 0 binds three listeners: jax.distributed on
+        # coordinatorPort, the gang barrier on coordinatorPort+1, and the
+        # libtpu megascale coordinator on DEFAULT_MEGASCALE_PORT — a
+        # collision surfaces as a bind failure or silent rendezvous hang.
+        port = spec.jax_distribution.coordinator_port
+        if constants.DEFAULT_MEGASCALE_PORT in (port, port + 1):
+            errs.append(
+                invalid(
+                    f"{path}.jaxDistribution.coordinatorPort",
+                    port,
+                    f"coordinatorPort and coordinatorPort+1 must not collide "
+                    f"with the megascale DCN port "
+                    f"{constants.DEFAULT_MEGASCALE_PORT} when numSlices > 1",
+                )
+            )
     return errs
 
 

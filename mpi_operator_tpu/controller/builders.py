@@ -179,6 +179,22 @@ def _worker_env(job: TPUJob, index: int, shape: topology.SliceShape) -> list[dic
         env += [
             {"name": constants.ENV_NUM_SLICES, "value": str(num_slices)},
             {"name": constants.ENV_SLICE_ID, "value": str(slice_id)},
+            # DCN wiring: libtpu megascale reads these to stitch slices
+            # together (the GKE JobSet contract). Slice 0's host 0
+            # coordinates; its stable FQDN exists before any pod runs, so
+            # no discovery step is needed.
+            {
+                "name": constants.ENV_MEGASCALE_COORDINATOR_ADDRESS,
+                "value": (
+                    f"{worker_fqdn(job, 0)}:{constants.DEFAULT_MEGASCALE_PORT}"
+                ),
+            },
+            {"name": constants.ENV_MEGASCALE_NUM_SLICES, "value": str(num_slices)},
+            {"name": constants.ENV_MEGASCALE_SLICE_ID, "value": str(slice_id)},
+            {
+                "name": constants.ENV_MEGASCALE_PORT,
+                "value": str(constants.DEFAULT_MEGASCALE_PORT),
+            },
         ]
     return env
 

@@ -32,6 +32,10 @@ class RendezvousConfig:
     chips_per_host: int = 0
     num_slices: int = 1
     slice_id: int = 0
+    megascale_coordinator_address: str = ""
+    megascale_num_slices: int = 0
+    megascale_slice_id: int = -1
+    megascale_port: int = 0
     job_name: str = ""
     job_namespace: str = ""
 
@@ -59,6 +63,12 @@ class RendezvousConfig:
             chips_per_host=_int(constants.ENV_TPU_CHIPS_PER_HOST, 0),
             num_slices=_int(constants.ENV_NUM_SLICES, 1),
             slice_id=_int(constants.ENV_SLICE_ID, 0),
+            megascale_coordinator_address=env.get(
+                constants.ENV_MEGASCALE_COORDINATOR_ADDRESS, ""
+            ),
+            megascale_num_slices=_int(constants.ENV_MEGASCALE_NUM_SLICES, 0),
+            megascale_slice_id=_int(constants.ENV_MEGASCALE_SLICE_ID, -1),
+            megascale_port=_int(constants.ENV_MEGASCALE_PORT, 0),
             job_name=env.get(constants.ENV_JOB_NAME, ""),
             job_namespace=env.get(constants.ENV_JOB_NAMESPACE, ""),
         )
@@ -70,6 +80,72 @@ class RendezvousConfig:
     @property
     def is_coordinator(self) -> bool:
         return self.process_id == 0
+
+    @property
+    def is_multislice(self) -> bool:
+        return self.num_slices > 1
+
+    @property
+    def hosts_per_slice(self) -> int:
+        return max(len(self.worker_hostnames), 1)
+
+    def check_multislice(self) -> None:
+        """Fail fast on inconsistent DCN wiring (a mis-wired megascale env
+        otherwise surfaces as an opaque libtpu hang at first collective).
+
+        The slice-local identity (TPU_WORKER_ID/HOSTNAMES) must agree with
+        the global identity (process id, slice id): process_id = slice_id
+        × hosts_per_slice + worker_id, and the whole world must divide
+        evenly into slices.
+        """
+        if not self.is_multislice:
+            return
+        if not self.megascale_coordinator_address:
+            raise RuntimeError(
+                f"num_slices={self.num_slices} but "
+                f"{constants.ENV_MEGASCALE_COORDINATOR_ADDRESS} is unset"
+            )
+        # The MEGASCALE_* values are what libtpu actually consumes — if a
+        # wrapper script or pod template overrode them out of agreement
+        # with the TPUJOB_* identity, two slices can claim the same id and
+        # the world wedges. Cross-check every one that is set.
+        if self.megascale_num_slices and self.megascale_num_slices != self.num_slices:
+            raise RuntimeError(
+                f"{constants.ENV_MEGASCALE_NUM_SLICES}="
+                f"{self.megascale_num_slices} disagrees with "
+                f"{constants.ENV_NUM_SLICES}={self.num_slices}"
+            )
+        if self.megascale_slice_id >= 0 and self.megascale_slice_id != self.slice_id:
+            raise RuntimeError(
+                f"{constants.ENV_MEGASCALE_SLICE_ID}={self.megascale_slice_id} "
+                f"disagrees with {constants.ENV_SLICE_ID}={self.slice_id}"
+            )
+        if self.megascale_port:
+            _, _, addr_port = self.megascale_coordinator_address.rpartition(":")
+            if addr_port.isdigit() and int(addr_port) != self.megascale_port:
+                raise RuntimeError(
+                    f"{constants.ENV_MEGASCALE_PORT}={self.megascale_port} "
+                    "disagrees with the port in "
+                    f"{constants.ENV_MEGASCALE_COORDINATOR_ADDRESS}="
+                    f"{self.megascale_coordinator_address}"
+                )
+        if self.num_processes % self.num_slices:
+            raise RuntimeError(
+                f"world of {self.num_processes} processes does not divide "
+                f"into {self.num_slices} slices"
+            )
+        per_slice = self.num_processes // self.num_slices
+        if self.worker_hostnames and per_slice != self.hosts_per_slice:
+            raise RuntimeError(
+                f"slice-local hostname list has {self.hosts_per_slice} "
+                f"hosts but the world implies {per_slice} per slice"
+            )
+        expect = self.slice_id * per_slice + self.worker_id
+        if self.process_id != expect:
+            raise RuntimeError(
+                f"process_id {self.process_id} inconsistent with slice "
+                f"{self.slice_id} worker {self.worker_id} (expected {expect})"
+            )
 
 
 _initialized = False
@@ -97,6 +173,15 @@ def initialize(
         return cfg
     if _initialized:
         return cfg
+    # Multislice: libtpu reads MEGASCALE_* from the environment on its
+    # own; our job is to fail fast if the controller-rendered wiring is
+    # inconsistent rather than hang in the first cross-slice collective.
+    cfg.check_multislice()
+    if cfg.is_multislice:
+        log.info(
+            "multislice world: slice %d/%d, DCN coordinator %s",
+            cfg.slice_id, cfg.num_slices, cfg.megascale_coordinator_address,
+        )
 
     if readiness_barrier and cfg.coordinator_address:
         from . import barrier

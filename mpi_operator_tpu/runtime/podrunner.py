@@ -119,6 +119,16 @@ class LocalPodRunner:
         # Hermetic: children run the JAX CPU backend, never the real TPU.
         env["JAX_PLATFORMS"] = "cpu"
         env["PALLAS_AXON_POOL_IPS"] = ""
+        # Don't inherit the test harness's virtual 8-device mesh: a worker
+        # pod models ONE host (its own chips), and 4+ workers × 8 virtual
+        # devices × XLA's thread pools thrash a CI machine enough to blow
+        # the 200 s e2e bound.
+        if "XLA_FLAGS" in env:
+            flags = [
+                f for f in env["XLA_FLAGS"].split()
+                if not f.startswith("--xla_force_host_platform_device_count")
+            ]
+            env["XLA_FLAGS"] = " ".join(flags)
         # The "image" of our simulated containers is the repo itself.
         env["PYTHONPATH"] = self.workdir + (
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""

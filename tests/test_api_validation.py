@@ -173,6 +173,24 @@ class TestInvalidJobs:
         errs = validate_tpujob(job)
         assert "spec.jaxDistribution.coordinatorPort" in fields(errs)
 
+    def test_multislice_coordinator_port_must_avoid_megascale_port(self):
+        # Worker 0 binds jax.distributed (port), the gang barrier
+        # (port+1), AND the megascale DCN coordinator (8080) — collisions
+        # must fail validation, not hang rendezvous.
+        for port in (8080, 8079):
+            job = valid_job()
+            job.spec.tpu.num_slices = 2
+            job.spec.replica_specs["Worker"].replicas = (
+                job.spec.replica_specs["Worker"].replicas or 0) * 2 or None
+            job.spec.jax_distribution = JAXDistributionSpec(coordinator_port=port)
+            errs = validate_tpujob(job)
+            assert "spec.jaxDistribution.coordinatorPort" in fields(errs), port
+        # single-slice jobs may use 8080 freely
+        job = valid_job()
+        job.spec.jax_distribution = JAXDistributionSpec(coordinator_port=8080)
+        errs = validate_tpujob(job)
+        assert "spec.jaxDistribution.coordinatorPort" not in fields(errs)
+
     def test_job_name_too_long_for_pod_hostname(self):
         # validation_test.go name-length analog: the generated worker
         # hostname must stay a DNS-1123 label.

@@ -544,6 +544,25 @@ class TestMultisliceEnv:
         assert env["TPUJOB_PROCESS_ID"] == "5"
         assert env["TPUJOB_NUM_PROCESSES"] == "8"
         assert env["TPUJOB_NUM_SLICES"] == "2"
+        # DCN (megascale) wiring: slice 0 host 0 coordinates, every pod
+        # carries its slice id — the GKE JobSet env contract.
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"].startswith(
+            "test-job-worker-0."
+        )
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"].endswith(":8080")
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "1"
+        assert env["MEGASCALE_PORT"] == "8080"
+
+    def test_single_slice_has_no_megascale_env(self):
+        f = Fixture()
+        f.start()
+        job = f.new_job(workers=4)
+        job = f.create_job(job)
+        f.sync(job)
+        pod = f.api.get("pods", "default", "test-job-worker-0")
+        names = {e["name"] for e in pod["spec"]["containers"][0]["env"]}
+        assert not any(n.startswith("MEGASCALE_") for n in names)
 
 
 class TestTerminalStatusGuards:

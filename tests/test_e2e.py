@@ -8,6 +8,7 @@ stand-in: worker pods are real processes, the collective traffic is real
 """
 
 import pathlib
+import socket
 import threading
 import time
 
@@ -58,6 +59,26 @@ def wait_for_condition(api, name, cond_type, timeout=FOREVER_TIMEOUT):
     raise AssertionError(f"timed out waiting for {name} to reach {cond_type}")
 
 
+def free_port_pair() -> int:
+    """A free port p whose p+1 is also free (the gang barrier binds
+    coordinatorPort+1). Fixed ports made reruns flaky: a prior run's
+    coordinator socket in TIME_WAIT stalls jax.distributed's bind-retry
+    loop for minutes."""
+    for _ in range(64):
+        with socket.socket() as a:
+            a.bind(("127.0.0.1", 0))
+            p = a.getsockname()[1]
+        if p + 1 >= 65536:
+            continue
+        try:
+            with socket.socket() as b:
+                b.bind(("127.0.0.1", p + 1))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no adjacent free port pair found")
+
+
 def load_job(path: str, **overrides) -> dict:
     doc = yaml.safe_load((REPO_ROOT / path).read_text())
     doc["metadata"]["namespace"] = "default"
@@ -73,7 +94,7 @@ class TestPiJob:
     def test_pi_job_succeeds(self, cluster):
         api, controller, runner = cluster
         doc = load_job("examples/v2beta1/pi/pi.yaml")
-        doc["spec"]["jaxDistribution"] = {"coordinatorPort": 8621}
+        doc["spec"]["jaxDistribution"] = {"coordinatorPort": free_port_pair()}
         api.create("tpujobs", doc)
         job = wait_for_condition(api, "pi", "Succeeded")
         # Both workers completed; pi printed on the coordinator.
@@ -82,12 +103,32 @@ class TestPiJob:
         # cleanPodPolicy Running: completed pods are kept.
         assert {p["status"]["phase"] for p in api.list("pods")} <= {"Succeeded"}
 
+    def test_two_slice_world_initializes(self, cluster):
+        """Multislice DCN rendezvous: a numSlices=2 job (2 hosts/slice x 2
+        slices = 4 real worker processes) forms ONE jax.distributed world;
+        every worker's initialize() runs check_multislice() against the
+        controller-rendered MEGASCALE_*/slice-local env, so Succeeded
+        proves the cross-slice wiring is consistent end-to-end."""
+        api, controller, runner = cluster
+        doc = load_job("examples/v2beta1/pi/pi.yaml")
+        doc["metadata"]["name"] = "pi-multislice"
+        doc["spec"]["jaxDistribution"] = {"coordinatorPort": free_port_pair()}
+        doc["spec"]["tpu"]["numSlices"] = 2
+        api.create("tpujobs", doc)
+        job = wait_for_condition(api, "pi-multislice", "Succeeded")
+        assert job["status"]["replicaStatuses"]["Worker"]["succeeded"] == 4
+        # The controller really rendered DCN env on a cross-slice pod.
+        pod = api.get("pods", "default", "pi-multislice-worker-3")
+        env = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+        assert env["MEGASCALE_SLICE_ID"] == "1"
+        assert env["TPU_WORKER_ID"] == "1"
+
     def test_malformed_command_fails(self, cluster):
         """mpi_job_test.go:103-112 analog."""
         api, controller, runner = cluster
         doc = load_job("examples/v2beta1/pi/pi.yaml")
         doc["metadata"]["name"] = "pi-broken"
-        doc["spec"]["jaxDistribution"] = {"coordinatorPort": 8622}
+        doc["spec"]["jaxDistribution"] = {"coordinatorPort": free_port_pair()}
         doc["spec"]["tpuReplicaSpecs"]["Worker"]["template"]["spec"]["containers"][0][
             "command"
         ] = ["python", "-c", "raise SystemExit(3)"]
@@ -105,7 +146,7 @@ class TestLauncherJob:
         api, controller, runner = cluster
         doc = load_job("examples/v2beta1/pi/pi.yaml")
         doc["metadata"]["name"] = "pi-launcher"
-        doc["spec"]["jaxDistribution"] = {"coordinatorPort": 8623}
+        doc["spec"]["jaxDistribution"] = {"coordinatorPort": free_port_pair()}
         doc["spec"]["tpuReplicaSpecs"]["Launcher"] = {
             "template": {
                 "spec": {

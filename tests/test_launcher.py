@@ -1,5 +1,7 @@
 """Launcher bootstrap tests (env parsing + single-process paths)."""
 
+import pytest
+
 from mpi_operator_tpu.launcher.bootstrap import RendezvousConfig, initialize
 from mpi_operator_tpu.launcher.healthcheck import run_healthcheck
 
@@ -35,6 +37,78 @@ class TestRendezvousConfig:
     def test_garbage_ints_fall_back(self):
         cfg = RendezvousConfig.from_env({"TPUJOB_NUM_PROCESSES": "banana"})
         assert cfg.num_processes == 1
+
+
+def _multislice_env(**over):
+    """Worker 5 of an 8-process, 2-slice world (slice 1, host 1)."""
+    env = dict(ENV)
+    env.update({
+        "TPUJOB_NUM_PROCESSES": "8",
+        "TPUJOB_PROCESS_ID": "5",
+        "TPU_WORKER_ID": "1",
+        "TPU_WORKER_HOSTNAMES": "e.svc,f.svc,g.svc,h.svc",
+        "TPUJOB_NUM_SLICES": "2",
+        "TPUJOB_SLICE_ID": "1",
+        "MEGASCALE_COORDINATOR_ADDRESS": "j-worker-0.j-worker.ns.svc:8080",
+        "MEGASCALE_NUM_SLICES": "2",
+        "MEGASCALE_SLICE_ID": "1",
+        "MEGASCALE_PORT": "8080",
+    })
+    env.update(over)
+    return env
+
+
+class TestMultislice:
+    def test_from_env_parses_dcn_wiring(self):
+        cfg = RendezvousConfig.from_env(_multislice_env())
+        assert cfg.is_multislice
+        assert cfg.megascale_coordinator_address == (
+            "j-worker-0.j-worker.ns.svc:8080"
+        )
+        assert cfg.megascale_port == 8080
+        assert cfg.slice_id == 1
+
+    def test_consistent_wiring_passes(self):
+        RendezvousConfig.from_env(_multislice_env()).check_multislice()
+
+    def test_missing_dcn_coordinator_fails_fast(self):
+        env = _multislice_env(MEGASCALE_COORDINATOR_ADDRESS="")
+        with pytest.raises(RuntimeError, match="MEGASCALE_COORDINATOR_ADDRESS"):
+            RendezvousConfig.from_env(env).check_multislice()
+
+    def test_world_must_divide_into_slices(self):
+        env = _multislice_env(TPUJOB_NUM_PROCESSES="7")
+        with pytest.raises(RuntimeError, match="does not divide"):
+            RendezvousConfig.from_env(env).check_multislice()
+
+    def test_slice_process_identity_must_agree(self):
+        # claims slice 1 host 1 but global process id 6 (= slice 1 host 2)
+        env = _multislice_env(TPUJOB_PROCESS_ID="6")
+        with pytest.raises(RuntimeError, match="inconsistent with slice"):
+            RendezvousConfig.from_env(env).check_multislice()
+
+    def test_hostname_list_must_match_slice_size(self):
+        env = _multislice_env(TPU_WORKER_HOSTNAMES="e.svc,f.svc")
+        with pytest.raises(RuntimeError, match="per slice"):
+            RendezvousConfig.from_env(env).check_multislice()
+
+    def test_single_slice_skips_checks(self):
+        RendezvousConfig.from_env(ENV).check_multislice()  # no-op
+
+    def test_megascale_override_disagreement_fails_fast(self):
+        # A wrapper script overriding what libtpu actually reads must not
+        # slip past the TPUJOB_*-only arithmetic.
+        env = _multislice_env(MEGASCALE_SLICE_ID="0")
+        with pytest.raises(RuntimeError, match="MEGASCALE_SLICE_ID"):
+            RendezvousConfig.from_env(env).check_multislice()
+        env = _multislice_env(MEGASCALE_NUM_SLICES="4")
+        with pytest.raises(RuntimeError, match="MEGASCALE_NUM_SLICES"):
+            RendezvousConfig.from_env(env).check_multislice()
+
+    def test_megascale_port_must_match_coordinator_address(self):
+        env = _multislice_env(MEGASCALE_PORT="9999")
+        with pytest.raises(RuntimeError, match="MEGASCALE_PORT"):
+            RendezvousConfig.from_env(env).check_multislice()
 
 
 class TestSingleProcess:
