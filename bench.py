@@ -1,14 +1,21 @@
 #!/usr/bin/env python3
-"""Benchmark: ResNet-101 synthetic-ImageNet training throughput per TPU chip.
+"""Benchmark suite: training throughput per TPU chip + operator latency.
 
-Reference baseline: the mpi-operator README's headline number — ResNet-101
-tf_cnn_benchmarks with Horovod at ~154.2 images/sec *per GPU*
-(/root/reference/README.md:191-206, BASELINE.md).  This benchmark runs the
-same model family (ResNet-101 v1.5, batch 64+/chip, synthetic ImageNet,
-bf16) as a jit-compiled GSPMD train step and reports images/sec/chip.
+Reference baselines (BASELINE.md): the mpi-operator README's headline
+number — ResNet-101 tf_cnn_benchmarks with Horovod at ~154.2 images/sec
+*per GPU* (/root/reference/README.md:191-206) — and the e2e latency bound
+(pi job Succeeded ≤ 200 s, v2/test/e2e/e2e_suite_test.go:55-56). The
+reference publishes nothing for transformers; BERT/Llama suites cover
+BASELINE.md milestone configs 3-4 so "matches or beats" is evidenced per
+model family, not just the headline.
 
-Prints exactly one JSON line:
+Default run (what the driver executes) benchmarks ResNet-101 and prints
+exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Other suites: --suite bert | llama | startup | all  (each prints its own
+single JSON line; `all` prints the headline line last and writes every
+result to PERF.md).
 """
 
 from __future__ import annotations
@@ -19,23 +26,43 @@ import sys
 import time
 
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 154.2  # reference per-GPU steady state
+BASELINE_E2E_BOUND_S = 200.0  # reference pi-job Succeeded bound
+V5E_BF16_PEAK_TFLOPS = 197.0  # per-chip peak, for MFU readouts
 
 
 def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
-def main() -> int:
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--depth", type=int, default=101)
-    parser.add_argument("--batch-per-chip", type=int, default=128)
-    parser.add_argument("--image-size", type=int, default=224)
-    parser.add_argument("--steps", type=int, default=30)
-    parser.add_argument("--warmup", type=int, default=5)
-    args = parser.parse_args()
-
+def _timed_steps(step, state, args_rest, steps: int, warmup: int):
+    """Run `warmup` untimed (callers pass >=1 unless already compiled)
+    then `steps` timed invocations of state = step(*state, *args_rest);
+    returns (state, seconds/step)."""
     import jax
-    import jax.numpy as jnp
+
+    for _ in range(warmup):
+        state = step(*state, *args_rest)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state = step(*state, *args_rest)
+    jax.block_until_ready(state)
+    return state, (time.perf_counter() - t0) / steps
+
+
+def _param_count(params) -> int:
+    import jax
+
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# ResNet (headline, milestone 2)
+# ---------------------------------------------------------------------------
+
+
+def bench_resnet(args) -> dict:
+    import jax
     import numpy as np
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -56,62 +83,312 @@ def main() -> int:
     optimizer = optax.sgd(learning_rate=0.1, momentum=0.9, nesterov=True)
     opt_state = optimizer.init(params)
 
-    # Replicate state, shard batch over dp.
     replicated = NamedSharding(mesh, P())
     params = jax.device_put(params, replicated)
     batch_stats = jax.device_put(batch_stats, replicated)
     opt_state = jax.device_put(opt_state, replicated)
 
     global_batch = args.batch_per_chip * n
+    # bf16 feed: the model computes in bf16 anyway; feeding f32 doubles
+    # the input HBM traffic for one in-graph cast.
+    import jax.numpy as jnp
+
     images = shard_batch(
         np.random.RandomState(0)
         .standard_normal((global_batch, args.image_size, args.image_size, 3))
         .astype(np.float32),
         mesh,
+    ).astype(jnp.bfloat16)
+    labels = shard_batch(
+        np.random.RandomState(1).randint(0, 1000, (global_batch,)), mesh
     )
-    labels = shard_batch(np.random.RandomState(1).randint(0, 1000, (global_batch,)), mesh)
 
     step = resnet_lib.make_train_step(model, optimizer)
     step = jax.jit(step, donate_argnums=(0, 1, 2))
 
-    log(f"compiling train step (global batch {global_batch})...")
-    t0 = time.perf_counter()
+    log(f"compiling resnet{args.depth} train step (global batch {global_batch})...")
+    fn = lambda p, b, o, i, l: step(p, b, o, i, l)[:3]  # drop loss from carry
+    state = (params, batch_stats, opt_state)
+    warmup = max(args.warmup, 1)  # >=1: compile outside the timed window
     with mesh:
-        for _ in range(max(args.warmup, 1)):  # >=1: compile outside timing
-            params, batch_stats, opt_state, loss = step(
-                params, batch_stats, opt_state, images, labels
+        if args.profile_dir:
+            # Warm/compile fully BEFORE the trace so it holds exactly
+            # args.steps steady-state steps, matching the reported timing.
+            state, _ = _timed_steps(fn, state, (images, labels), 0, warmup)
+            jax.profiler.start_trace(args.profile_dir)
+            state, sec = _timed_steps(fn, state, (images, labels), args.steps, 0)
+            jax.profiler.stop_trace()
+            log(f"profile written to {args.profile_dir}")
+        else:
+            state, sec = _timed_steps(
+                fn, state, (images, labels), args.steps, warmup
             )
-        jax.block_until_ready(loss)
-        log(f"warmup done in {time.perf_counter() - t0:.1f}s; loss={float(loss):.3f}")
 
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            params, batch_stats, opt_state, loss = step(
-                params, batch_stats, opt_state, images, labels
-            )
-        jax.block_until_ready(loss)
-        elapsed = time.perf_counter() - t0
-
-    images_per_sec = global_batch * args.steps / elapsed
-    per_chip = images_per_sec / n
-    step_ms = elapsed / args.steps * 1000
-    # MFU accounting: fwd+bwd ~= 3x fwd FLOPs.
+    per_chip = global_batch / sec / n
     flops = 3 * resnet_lib.flops_per_image(args.depth, args.image_size)
+    tflops = flops * per_chip / 1e12
     log(
-        f"{images_per_sec:.1f} images/sec total, {per_chip:.1f}/chip, "
-        f"{step_ms:.1f} ms/step, ~{flops * per_chip / 1e12:.2f} TFLOP/s/chip"
+        f"{per_chip * n:.1f} images/sec total, {per_chip:.1f}/chip, "
+        f"{sec * 1000:.1f} ms/step, ~{tflops:.2f} TFLOP/s/chip "
+        f"(~{100 * tflops / V5E_BF16_PEAK_TFLOPS:.1f}% of v5e bf16 peak)"
     )
+    return {
+        "metric": f"resnet{args.depth}_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
+    }
 
-    print(
-        json.dumps(
-            {
-                "metric": f"resnet{args.depth}_images_per_sec_per_chip",
-                "value": round(per_chip, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
-            }
-        )
+
+# ---------------------------------------------------------------------------
+# BERT-base MLM (milestone 3)
+# ---------------------------------------------------------------------------
+
+
+def bench_bert(args) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpi_operator_tpu.models import bert as bert_lib
+    from mpi_operator_tpu.parallel import create_mesh, shard_batch
+
+    n = len(jax.devices())
+    mesh = create_mesh(dp=-1)  # data-parallel over every chip
+    seq_len = args.seq_len or 512
+    cfg = bert_lib.bert_base()
+    model = bert_lib.Bert(cfg)
+    params = bert_lib.init_params(
+        model, jax.random.PRNGKey(0), batch=2, seq=seq_len
     )
+    n_params = _param_count(params)
+    optimizer = optax.adamw(1e-4)
+    opt_state = optimizer.init(params)
+    replicated = NamedSharding(mesh, P())
+    params = jax.device_put(params, replicated)
+    opt_state = jax.device_put(opt_state, replicated)
+
+    batch = args.bert_batch * n  # global batch, sharded over dp
+    rng = np.random.RandomState(0)
+    tokens = shard_batch(rng.randint(0, cfg.vocab_size, (batch, seq_len)), mesh)
+    # 15% MLM positions, BERT pretraining convention.
+    mask = shard_batch(rng.uniform(size=(batch, seq_len)) < 0.15, mesh)
+    targets = shard_batch(rng.randint(0, cfg.vocab_size, (batch, seq_len)), mesh)
+
+    step = jax.jit(
+        bert_lib.make_train_step(model, optimizer), donate_argnums=(0, 1)
+    )
+    log(f"compiling bert-base train step (batch {batch} x seq {seq_len}, "
+        f"{n_params / 1e6:.0f}M params)...")
+    with mesh:
+        (_, _, loss), sec = _timed_steps(
+            lambda p, o, l_, t, m, tg: step(p, o, t, m, tg),
+            (params, opt_state, None), (tokens, mask, targets),
+            args.steps, max(args.warmup, 1),
+        )
+
+    seqs_per_sec = batch / sec / n
+    # Train FLOPs/token ≈ 6·N_params + 12·L·d·s (full bidirectional
+    # attention; PaLM-appendix accounting, fwd+bwd = 3× fwd).
+    flops_tok = 6 * n_params + 12 * cfg.n_layers * cfg.dim * seq_len
+    tflops = flops_tok * batch * seq_len / sec / n / 1e12
+    log(
+        f"bert-base: {seqs_per_sec:.1f} seq/s/chip, {sec * 1000:.1f} ms/step, "
+        f"loss {float(loss):.3f}, ~{tflops:.1f} TFLOP/s/chip "
+        f"(~{100 * tflops / V5E_BF16_PEAK_TFLOPS:.1f}% of v5e bf16 peak)"
+    )
+    return {
+        "metric": "bert_base_mlm_sequences_per_sec_per_chip",
+        "value": round(seqs_per_sec, 2),
+        "unit": f"seq({seq_len})/sec/chip",
+        # No reference transformer baseline exists; report MFU fraction.
+        "vs_baseline": round(tflops / V5E_BF16_PEAK_TFLOPS, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Llama causal LM (milestone 4, single-chip shape)
+# ---------------------------------------------------------------------------
+
+
+def bench_llama(args) -> dict:
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpi_operator_tpu.models import llama as llama_lib
+    from mpi_operator_tpu.parallel import create_mesh, shard_batch
+
+    n = len(jax.devices())
+    mesh = create_mesh(dp=-1)  # data-parallel over every chip
+    seq_len = args.seq_len or 2048
+    # Real Llama-3 structure (GQA, RoPE, SwiGLU, remat, flash attention)
+    # at ~0.7B so params + adamw state fit one v5e chip; the full 8B shape
+    # is exercised as a sharded dryrun by __graft_entry__.dryrun_multichip.
+    cfg = llama_lib.llama3_8b(
+        vocab_size=32768, dim=2048, n_layers=12, n_heads=16, n_kv_heads=8,
+        ffn_dim=6144, max_seq_len=seq_len,
+    )
+    model = llama_lib.Llama(cfg)
+    params = llama_lib.init_params(
+        model, jax.random.PRNGKey(0), batch=1, seq=seq_len
+    )
+    n_params = _param_count(params)
+    optimizer = optax.adamw(3e-4)
+    opt_state = optimizer.init(params)
+    replicated = NamedSharding(mesh, P())
+    params = jax.device_put(params, replicated)
+    opt_state = jax.device_put(opt_state, replicated)
+
+    batch = args.llama_batch * n  # global batch, sharded over dp
+    tokens = shard_batch(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq_len)),
+        mesh,
+    )
+    step = jax.jit(
+        llama_lib.make_train_step(model, optimizer), donate_argnums=(0, 1)
+    )
+    log(f"compiling llama train step ({n_params / 1e6:.0f}M params, "
+        f"batch {batch} x seq {seq_len})...")
+    with mesh:
+        (_, _, loss), sec = _timed_steps(
+            lambda p, o, l_, t: step(p, o, t),
+            (params, opt_state, None), (tokens,),
+            args.steps, max(args.warmup, 1),
+        )
+
+    tokens_per_sec = batch * seq_len / sec / n
+    # Causal attention: half the score matrix is masked → 6·L·d·s.
+    flops_tok = 6 * n_params + 6 * cfg.n_layers * cfg.dim * seq_len
+    tflops = flops_tok * tokens_per_sec / 1e12
+    log(
+        f"llama-{n_params / 1e6:.0f}M: {tokens_per_sec:.0f} tok/s/chip, "
+        f"{sec * 1000:.1f} ms/step, loss {float(loss):.3f}, "
+        f"~{tflops:.1f} TFLOP/s/chip "
+        f"(~{100 * tflops / V5E_BF16_PEAK_TFLOPS:.1f}% of v5e bf16 peak)"
+    )
+    return {
+        "metric": "llama_0p7b_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": f"tokens({seq_len})/sec/chip",
+        "vs_baseline": round(tflops / V5E_BF16_PEAK_TFLOPS, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Startup-to-first-step (the second primary metric in BASELINE.md)
+# ---------------------------------------------------------------------------
+
+
+def bench_startup(args) -> dict:
+    """TPUJob create → pi job Succeeded through the full operator stack
+    (reconciler, pod runner, gang barrier, jax.distributed rendezvous,
+    one collective). The reference's only latency figure is its e2e bound:
+    pi Succeeded ≤ 200 s on a kind cluster."""
+    import os
+    import pathlib
+    import threading
+
+    # The workload is operator machinery + subprocess workers on the JAX
+    # CPU backend — force CPU in THIS process too so nothing touches a
+    # real chip mid-benchmark.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import yaml
+
+    from mpi_operator_tpu.controller.tpu_job_controller import TPUJobController
+    from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer
+    from mpi_operator_tpu.runtime.podrunner import LocalPodRunner
+    from mpi_operator_tpu.utils.net import free_port_pair
+
+    root = pathlib.Path(__file__).resolve().parent
+    port = free_port_pair()  # the gang barrier binds port+1 too
+
+    api = InMemoryAPIServer()
+    controller = TPUJobController(api)
+    runner = LocalPodRunner(api, workdir=str(root))
+    stop = threading.Event()
+    threading.Thread(
+        target=lambda: controller.run(threadiness=2, stop=stop), daemon=True
+    ).start()
+    runner.start()
+    try:
+        doc = yaml.safe_load(
+            (root / "examples/v2beta1/pi/pi.yaml").read_text()
+        )
+        doc["metadata"]["namespace"] = "default"
+        doc["spec"]["jaxDistribution"] = {"coordinatorPort": port}
+        t0 = time.perf_counter()
+        api.create("tpujobs", doc)
+        elapsed = None
+        while time.perf_counter() - t0 < BASELINE_E2E_BOUND_S:
+            job = api.get("tpujobs", "default", "pi")
+            conds = (job.get("status") or {}).get("conditions") or []
+            if any(c["type"] == "Succeeded" and c["status"] == "True" for c in conds):
+                elapsed = time.perf_counter() - t0
+                break
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        runner.stop()
+    if elapsed is None:
+        raise RuntimeError("pi job did not reach Succeeded within the bound")
+    log(f"pi e2e: create -> Succeeded in {elapsed:.1f}s "
+        f"(reference bound {BASELINE_E2E_BOUND_S:.0f}s)")
+    return {
+        "metric": "pi_e2e_startup_to_succeeded_seconds",
+        "value": round(elapsed, 2),
+        "unit": "seconds",
+        # >1 = faster than the reference's 200 s e2e bound.
+        "vs_baseline": round(BASELINE_E2E_BOUND_S / elapsed, 2),
+    }
+
+
+SUITES = {
+    "resnet": bench_resnet,
+    "bert": bench_bert,
+    "llama": bench_llama,
+    "startup": bench_startup,
+}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--suite", choices=[*SUITES, "all"], default="resnet")
+    parser.add_argument("--depth", type=int, default=101)
+    parser.add_argument("--batch-per-chip", type=int, default=128)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--seq-len", type=int, default=None,
+                        help="sequence length (default: 512 bert, 2048 llama)")
+    parser.add_argument("--bert-batch", type=int, default=64)
+    parser.add_argument("--llama-batch", type=int, default=8)
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--warmup", type=int, default=5)
+    parser.add_argument("--profile-dir", default="")
+    parser.add_argument("--perf-md", default="",
+                        help="append results as a markdown table row file")
+    args = parser.parse_args()
+
+    if args.suite == "all":
+        results = {}
+        for name, fn in SUITES.items():
+            log(f"=== suite: {name} ===")
+            results[name] = fn(args)
+        if args.perf_md:
+            with open(args.perf_md, "a") as f:
+                for name, r in results.items():
+                    f.write(
+                        f"| {r['metric']} | {r['value']} {r['unit']} "
+                        f"| {r['vs_baseline']} |\n"
+                    )
+        # Headline line last (single-line contract holders parse stdout).
+        print(json.dumps(results["resnet"]))
+        return 0
+
+    print(json.dumps(SUITES[args.suite](args)))
     return 0
 
 

@@ -8,7 +8,6 @@ stand-in: worker pods are real processes, the collective traffic is real
 """
 
 import pathlib
-import socket
 import threading
 import time
 
@@ -20,6 +19,7 @@ from mpi_operator_tpu.controller import status as st
 from mpi_operator_tpu.controller.tpu_job_controller import TPUJobController
 from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer
 from mpi_operator_tpu.runtime.podrunner import LocalPodRunner
+from mpi_operator_tpu.utils.net import free_port_pair
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 FOREVER_TIMEOUT = 200  # e2e_suite_test.go:55-56 analog
@@ -57,26 +57,6 @@ def wait_for_condition(api, name, cond_type, timeout=FOREVER_TIMEOUT):
                     return job
         time.sleep(0.2)
     raise AssertionError(f"timed out waiting for {name} to reach {cond_type}")
-
-
-def free_port_pair() -> int:
-    """A free port p whose p+1 is also free (the gang barrier binds
-    coordinatorPort+1). Fixed ports made reruns flaky: a prior run's
-    coordinator socket in TIME_WAIT stalls jax.distributed's bind-retry
-    loop for minutes."""
-    for _ in range(64):
-        with socket.socket() as a:
-            a.bind(("127.0.0.1", 0))
-            p = a.getsockname()[1]
-        if p + 1 >= 65536:
-            continue
-        try:
-            with socket.socket() as b:
-                b.bind(("127.0.0.1", p + 1))
-            return p
-        except OSError:
-            continue
-    raise RuntimeError("no adjacent free port pair found")
 
 
 def load_job(path: str, **overrides) -> dict:
