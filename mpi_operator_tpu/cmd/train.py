@@ -51,8 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--model", default="resnet101",
                    help="resnet18|resnet50|resnet101|bert-base|bert-tiny|"
-                        "llama3-8b|llama-tiny")
-    p.add_argument("--mesh", default="", help="axis spec, e.g. dp=2,fsdp=4,tp=2")
+                        "llama3-8b|llama-tiny|mixtral-8x7b|llama-moe-tiny")
+    p.add_argument("--mesh", default="",
+                   help="axis spec, e.g. dp=2,fsdp=4,tp=2 (axes: dp fsdp ep tp sp)")
     p.add_argument("--steps", type=int, default=100,
                    help="ABSOLUTE target step: a resumed run trains only the "
                         "remainder from the latest checkpoint")
@@ -173,6 +174,10 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
         attention = "ring" if sp > 1 else "flash"
         if args.model == "llama3-8b":
             cfg = lib.llama3_8b(attention_impl=attention)
+        elif args.model == "mixtral-8x7b":
+            cfg = lib.mixtral_8x7b(attention_impl=attention)
+        elif args.model == "llama-moe-tiny":
+            cfg = lib.tiny_moe(attention_impl=attention)
         else:
             cfg = lib.tiny(attention_impl=attention)
         model = lib.Llama(cfg, mesh=mesh)
@@ -214,7 +219,7 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
 def build_workload(args, mesh, n_devices: int) -> Workload:
     if args.model.startswith("resnet"):
         return _resnet_workload(args, mesh, n_devices)
-    if args.model.startswith(("bert", "llama")):
+    if args.model.startswith(("bert", "llama", "mixtral")):
         return _lm_workload(args, mesh, n_devices)
     raise SystemExit(f"unknown --model {args.model!r}")
 

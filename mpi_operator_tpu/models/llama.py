@@ -49,10 +49,22 @@ class LlamaConfig:
     # 'flash' (pallas kernel), 'dense' (XLA reference), or 'ring'
     # (sequence-parallel over the sp mesh axis; requires mesh context).
     attention_impl: str = "flash"
+    # Sparse MoE FFN (models/moe.py): 0 = dense SwiGLU; > 0 replaces every
+    # block's MLP with n_experts experts routed top-k, experts sharded
+    # over the ep mesh axis. The train loss adds router_aux_coef × the
+    # Switch load-balance loss.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
 
 
 def llama3_8b(**overrides) -> LlamaConfig:
@@ -67,6 +79,22 @@ def tiny(**overrides) -> LlamaConfig:
         attention_impl="dense",
     )
     return dataclasses.replace(base, **overrides)
+
+
+def mixtral_8x7b(**overrides) -> LlamaConfig:
+    """Mixtral-style sparse MoE: Llama structure, 8 experts routed top-2."""
+    base = LlamaConfig(
+        vocab_size=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        ffn_dim=14336, max_seq_len=32768, rope_theta=1e6,
+        n_experts=8, moe_top_k=2,
+    )
+    return dataclasses.replace(base, **overrides)
+
+
+def tiny_moe(**overrides) -> LlamaConfig:
+    """Test-scale MoE config (4 experts, top-2)."""
+    merged = {"n_experts": 4, "moe_top_k": 2, **overrides}
+    return tiny(**merged)
 
 
 def _rope(x, positions, theta: float):
@@ -153,12 +181,25 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions):
+        """Returns (x, aux): aux is the router load-balance loss for MoE
+        configs, a constant 0 for dense ones (uniform pytree shape keeps
+        remat and scan-style wrappers oblivious)."""
         cfg = self.config
         x = x + Attention(cfg, self.mesh, name="attn")(
             RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions
         )
-        x = x + MLP(cfg, name="mlp")(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
-        return x
+        h = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
+        if cfg.is_moe:
+            from .moe import MoEMLP
+
+            y, aux = MoEMLP(
+                dim=cfg.dim, ffn_dim=cfg.ffn_dim, n_experts=cfg.n_experts,
+                top_k=cfg.moe_top_k, capacity_factor=cfg.capacity_factor,
+                dtype=cfg.dtype, mesh=self.mesh, name="moe",
+            )(h)
+        else:
+            y, aux = MLP(cfg, name="mlp")(h), jnp.float32(0.0)
+        return x + y, aux
 
 
 class Llama(nn.Module):
@@ -179,23 +220,29 @@ class Llama(nn.Module):
         block = Block
         if cfg.remat:
             block = nn.remat(Block, static_argnums=())
+        aux_total = jnp.float32(0.0)
         for i in range(cfg.n_layers):
-            h = block(cfg, self.mesh, name=f"layer_{i}")(h, positions)
+            h, aux = block(cfg, self.mesh, name=f"layer_{i}")(h, positions)
+            aux_total = aux_total + aux
         h = RMSNorm(cfg.norm_eps, name="final_norm")(h)
         # Untied lm_head (Llama-3 does not tie embeddings); f32 logits for
         # a stable softmax-CE.
         if cfg.tie_embeddings:
             # Explicit f32 matmul: Embed.attend would promote back to the
             # module dtype (bf16) and silently drop the f32 guarantee.
-            return jnp.dot(
+            logits = jnp.dot(
                 h.astype(jnp.float32),
                 emb.embedding.astype(jnp.float32).T,
                 preferred_element_type=jnp.float32,
             )
-        return nn.Dense(
-            cfg.vocab_size, use_bias=False, dtype=jnp.float32,
-            param_dtype=jnp.float32, name="lm_head",
-        )(h.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                param_dtype=jnp.float32, name="lm_head",
+            )(h.astype(jnp.float32))
+        # MoE configs also hand back the summed router aux loss; dense
+        # callers keep the plain-logits contract.
+        return (logits, aux_total) if cfg.is_moe else logits
 
 
 def init_params(model: Llama, rng, batch: int = 2, seq: int = 16):
@@ -204,14 +251,18 @@ def init_params(model: Llama, rng, batch: int = 2, seq: int = 16):
 
 
 def loss_fn(model: Llama, params, tokens):
-    """Next-token cross-entropy. The full sequence goes through the model
-    (keeping the length divisible by the sp axis for ring attention); the
-    shift happens on the logits."""
-    logits = model.apply({"params": params}, tokens)
+    """Next-token cross-entropy (+ router aux loss for MoE configs). The
+    full sequence goes through the model (keeping the length divisible by
+    the sp axis for ring attention); the shift happens on the logits."""
+    out = model.apply({"params": params}, tokens)
+    if model.config.is_moe:
+        logits, aux = out
+    else:
+        logits, aux = out, 0.0
     ce = optax.softmax_cross_entropy_with_integer_labels(
         logits[:, :-1], tokens[:, 1:]
     )
-    return jnp.mean(ce)
+    return jnp.mean(ce) + model.config.router_aux_coef * aux
 
 
 def make_train_step(model: Llama, optimizer):
@@ -236,9 +287,11 @@ def param_sharding_rules(mesh):
     """
     from ..parallel.sharding import ends_with, mesh_axis
 
+    from . import moe as moe_lib
+
     tp = mesh_axis(mesh, TP)
     fsdp = mesh_axis(mesh, FSDP)
-    return [
+    return moe_lib.param_sharding_rules(mesh) + [
         (ends_with("wq/kernel", "wk/kernel", "wv/kernel",
                    "w_gate/kernel", "w_up/kernel"), P(fsdp, tp)),
         (ends_with("wo/kernel", "w_down/kernel"), P(tp, fsdp)),

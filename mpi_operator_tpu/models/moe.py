@@ -1,0 +1,180 @@
+"""Sparse Mixture-of-Experts FFN with expert parallelism over an ``ep``
+mesh axis.
+
+The reference operator delegates all model math to user containers; MoE
+is part of this framework's compute layer the way ring attention is
+(SURVEY.md §2.4). The design is the canonical TPU formulation (GShard /
+Switch / t5x): routing becomes *static-shape dispatch and combine
+einsums*, so there is no data-dependent gather — XLA tiles the expert
+matmuls onto the MXU and inserts the all-to-alls from the shardings
+alone (experts sharded over ``ep``, groups over ``dp``/``fsdp``).
+
+Shapes (G groups = batch, S tokens/group, E experts, C capacity, D model
+dim, F expert hidden dim):
+
+    router probs    [G, S, E]     f32 softmax
+    dispatch        [G, S, E, C]  0/1 — token (g, s) → slot (e, c)
+    combine         [G, S, E, C]  dispatch × gate weight
+    expert inputs   [E, G, C, D]  = einsum('gsec,gsd->egcd', dispatch, x)
+    expert SwiGLU   [E, D, F] / [E, F, D] stacked weights, ep-sharded
+    output          [G, S, D]     = einsum('gsec,egcd->gsd', combine, h)
+
+Capacity is per group: C = ceil(top_k · S / E · capacity_factor).
+Tokens that overflow an expert's slots are dropped for that choice
+(their combine weight is 0) — Switch semantics; the residual connection
+around the FFN carries them through unchanged.
+
+Load-balance auxiliary loss is the Switch formulation
+``E · Σ_e f_e · p_e`` (f_e = fraction of tokens whose top-1 choice is e,
+p_e = mean router probability), ≈ 1.0 at perfect balance.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..parallel.mesh import DP, EP, FSDP, TP
+from ..parallel.sharding import active_mesh_axis as _axis
+
+
+def expert_capacity(
+    tokens_per_group: int, n_experts: int, top_k: int, capacity_factor: float
+) -> int:
+    return max(1, math.ceil(top_k * tokens_per_group / n_experts * capacity_factor))
+
+
+def routing(probs, top_k: int, capacity: int, *, normalize: bool = True):
+    """Static-shape top-k routing → (dispatch, combine, aux_loss).
+
+    probs: [G, S, E] router probabilities (f32). Choice priority is
+    k-major (every token's 1st choice claims slots before any 2nd
+    choice), matching GShard so a token's primary expert is the last to
+    drop it under pressure.
+    """
+    g, s, e = probs.shape
+    gates, idx = jax.lax.top_k(probs, top_k)  # [G, S, K]
+    if normalize:  # Mixtral convention: selected gates sum to 1
+        gates = gates / jnp.maximum(
+            jnp.sum(gates, axis=-1, keepdims=True), 1e-9
+        )
+
+    oh = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [G, S, K, E]
+    # Slot assignment: cumulative count over (k, s) within each group.
+    oh_k = oh.transpose(0, 2, 1, 3)  # [G, K, S, E]
+    pos = jnp.cumsum(oh_k.reshape(g, top_k * s, e), axis=1).reshape(
+        g, top_k, s, e
+    )
+    pos = (pos - 1.0) * oh_k  # position of each (k, s) inside its expert
+    pos_sel = jnp.sum(pos, axis=-1)  # [G, K, S]
+    keep = (pos_sel < capacity) & (jnp.sum(oh_k, axis=-1) > 0)
+
+    slot = jax.nn.one_hot(
+        pos_sel.astype(jnp.int32), capacity, dtype=jnp.float32
+    )  # [G, K, S, C]
+    disp_k = (
+        oh_k[..., None] * slot[..., None, :] * keep[..., None, None]
+    )  # [G, K, S, E, C]
+    dispatch = jnp.sum(disp_k, axis=1)  # sum over K → [G, S, E, C]
+    gates_k = gates.transpose(0, 2, 1)  # [G, K, S]
+    combine = jnp.sum(disp_k * gates_k[..., None, None], axis=1)  # [G, S, E, C]
+
+    # Switch load-balance loss on top-1 assignments.
+    top1 = jax.nn.one_hot(idx[..., 0], e, dtype=jnp.float32)  # [G, S, E]
+    f = jnp.mean(top1, axis=1)  # [G, E] fraction routed
+    p = jnp.mean(probs, axis=1)  # [G, E] mean prob
+    aux = e * jnp.mean(jnp.sum(f * p, axis=-1))
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for the dense SwiGLU MLP: returns (out, aux)."""
+
+    dim: int
+    ffn_dim: int
+    n_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x):
+        g, s, d = x.shape
+        e, f = self.n_experts, self.ffn_dim
+        cap = expert_capacity(s, e, self.top_k, self.capacity_factor)
+
+        # Router in f32: tiny matmul, and bf16 softmax here visibly skews
+        # balance at scale.
+        router = self.param(
+            "router", nn.initializers.lecun_normal(), (d, e), jnp.float32
+        )
+        probs = jax.nn.softmax(
+            jnp.einsum("gsd,de->gse", x.astype(jnp.float32), router), axis=-1
+        )
+        dispatch, combine, aux = routing(probs, self.top_k, cap)
+        dispatch = dispatch.astype(self.dtype)
+        combine = combine.astype(jnp.float32)
+
+        init = nn.initializers.lecun_normal(batch_axis=(0,))
+        w_gate = self.param("expert_wg", init, (e, d, f), jnp.float32)
+        w_up = self.param("expert_wu", init, (e, d, f), jnp.float32)
+        w_down = self.param("expert_wd", init, (e, f, d), jnp.float32)
+
+        ep = _axis(self.mesh, EP)
+        batch_axes = tuple(a for a in (DP, FSDP) if _axis(self.mesh, a))
+        constrain = (
+            (lambda t, spec: jax.lax.with_sharding_constraint(t, spec))
+            if self.mesh is not None and (ep or batch_axes)
+            else (lambda t, spec: t)
+        )
+        from jax.sharding import PartitionSpec as P
+
+        # All-to-all moment: groups-sharded tokens → experts-sharded rows.
+        xin = x.astype(self.dtype)
+        expert_in = jnp.einsum("gsec,gsd->egcd", dispatch, xin)
+        expert_in = constrain(
+            expert_in, P(ep, batch_axes if batch_axes else None, None, None)
+        )
+
+        tp = _axis(self.mesh, TP)
+        h_gate = jnp.einsum(
+            "egcd,edf->egcf", expert_in, w_gate.astype(self.dtype)
+        )
+        h_up = jnp.einsum("egcd,edf->egcf", expert_in, w_up.astype(self.dtype))
+        h = constrain(
+            nn.silu(h_gate) * h_up,
+            P(ep, batch_axes if batch_axes else None, None, tp),
+        )
+        expert_out = jnp.einsum("egcf,efd->egcd", h, w_down.astype(self.dtype))
+        expert_out = constrain(
+            expert_out, P(ep, batch_axes if batch_axes else None, None, None)
+        )
+
+        # All-to-all back: experts-sharded rows → groups-sharded tokens.
+        out = jnp.einsum(
+            "gsec,egcd->gsd", combine, expert_out.astype(jnp.float32)
+        )
+        return out.astype(x.dtype), aux
+
+
+def param_sharding_rules(mesh):
+    """Sharding rules for MoE params (compose with the host model's):
+    expert dim over ep, expert hidden dim over tp, model dim over fsdp;
+    the router is tiny — replicate it."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import ends_with, mesh_axis
+
+    ep = mesh_axis(mesh, EP)
+    tp = mesh_axis(mesh, TP)
+    fsdp = mesh_axis(mesh, FSDP)
+    return [
+        (ends_with("expert_wg", "expert_wu"), P(ep, fsdp, tp)),
+        (ends_with("expert_wd"), P(ep, tp, fsdp)),
+        (ends_with("router"), P()),
+    ]

@@ -85,6 +85,17 @@ def mesh_axis(mesh, name: str) -> Optional[str]:
     return name if name in mesh.axis_names else None
 
 
+def active_mesh_axis(mesh, name: str) -> Optional[str]:
+    """Like ``mesh_axis`` but also None for size-1 axes (and a None mesh):
+    for in-graph sharding *constraints*, where naming a trivial axis only
+    adds noise to the compiled HLO. Param-placement rules keep using
+    ``mesh_axis`` — a P(axis-of-size-1) placement is harmless there."""
+    if mesh is None:
+        return None
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return name if sizes.get(name, 1) > 1 else None
+
+
 def ends_with(*suffixes):
     """Predicate factory for ``shard_params`` rules: matches a param whose
     '/'-joined path ends with any suffix. Shared by the model families so
