@@ -53,7 +53,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resnet18|resnet50|resnet101|bert-base|bert-tiny|"
                         "llama3-8b|llama-tiny|mixtral-8x7b|llama-moe-tiny")
     p.add_argument("--mesh", default="",
-                   help="axis spec, e.g. dp=2,fsdp=4,tp=2 (axes: dp fsdp ep tp sp)")
+                   help="axis spec, e.g. dp=2,fsdp=4,tp=2 "
+                        "(axes: dp pp fsdp ep tp sp)")
     p.add_argument("--steps", type=int, default=100,
                    help="ABSOLUTE target step: a resumed run trains only the "
                         "remainder from the latest checkpoint")

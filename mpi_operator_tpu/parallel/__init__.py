@@ -4,11 +4,18 @@ The reference operator never inspects tensor layouts (SURVEY.md §2.4);
 parallelism lives in user programs.  In this framework the same layering
 holds — the *operator* hands out topology (TPU_WORKER_* env), and this
 package turns that topology into ``jax.sharding.Mesh`` axes + partition
-specs for the example workloads: dp (data), fsdp (ZeRO-style parameter
-sharding), tp (tensor/model), sp (sequence/context).
+specs for the example workloads: dp (data), pp (pipeline stages), fsdp
+(ZeRO-style parameter sharding), ep (MoE experts), tp (tensor/model),
+sp (sequence/context).
 """
 
 from .mesh import MeshConfig, create_mesh, local_batch_size  # noqa: F401
+
+# Exported as run_pipeline: re-exporting the function under its module's
+# own name would shadow `parallel.pipeline` (the submodule) on the
+# package, breaking `import mpi_operator_tpu.parallel.pipeline as ...`.
+from .pipeline import microbatch, unmicrobatch  # noqa: F401
+from .pipeline import pipeline as run_pipeline  # noqa: F401
 from .sharding import (  # noqa: F401
     batch_spec,
     fsdp_param_spec,

@@ -4,7 +4,7 @@ Axis convention (outer → inner, so the innermost axes map to ICI
 neighbors and the outermost to DCN hops — multislice jobs put ``dp``
 across slices):
 
-    ('dp', 'fsdp', 'ep', 'tp', 'sp')
+    ('dp', 'pp', 'fsdp', 'ep', 'tp', 'sp')
 
 Any subset may be used; sizes multiply to the device count.  A size of
 ``-1`` means "whatever is left" (at most one axis).
@@ -24,12 +24,13 @@ from typing import Mapping, Optional, Sequence
 import numpy as np
 
 DP = "dp"
+PP = "pp"  # pipeline parallelism: layer stages live here
 FSDP = "fsdp"
 EP = "ep"  # expert parallelism: MoE expert dim lives here
 TP = "tp"
 SP = "sp"
 
-STANDARD_AXES = (DP, FSDP, EP, TP, SP)
+STANDARD_AXES = (DP, PP, FSDP, EP, TP, SP)
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,152 @@
+"""Pipeline parallelism over a ``pp`` mesh axis — GPipe schedule as pure
+SPMD collectives.
+
+The reference scales out by handing ranks to an MPI program and letting
+the user's framework pipeline (SURVEY.md §2.4); here the framework owns
+the schedule, built the TPU way: every device runs the SAME program
+(shard_map), stage weights live on their device (leading stage dim
+sharded over ``pp``), and activations hop stage→stage with
+``lax.ppermute`` — a neighbor exchange that rides ICI, never a
+scatter/gather through host memory.
+
+Schedule: M microbatches over P stages take M + P − 1 ticks. At tick t,
+stage i computes microbatch t − i (bubble ticks compute on garbage and
+are masked — branchless, so the loop body stays a single fused XLA
+while-body). Reverse-mode autodiff replays the scan backwards and flips
+every ppermute, which IS the backward pipeline schedule — no hand-built
+1F1B machinery.
+
+Composes with the other axes: the microbatch dim can shard over ``dp``
+and the per-stage ``fn`` may use tp-sharded weights — pass ``state_spec``
+naming those axes. The stage loop itself only ever talks over ``pp``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .mesh import PP
+
+
+def num_microbatches(global_batch: int, microbatch: int) -> int:
+    if global_batch % microbatch:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by microbatch {microbatch}"
+        )
+    return global_batch // microbatch
+
+
+def pipeline(
+    fn: Callable,
+    stage_params,
+    x,
+    mesh,
+    *,
+    axis: str = PP,
+    state_spec: Optional[P] = None,
+):
+    """Run ``fn`` as a P-stage pipeline over microbatched input.
+
+    fn:            (params_for_one_stage, h) -> h, the per-stage function
+                   (identical structure on every stage — SPMD).
+    stage_params:  pytree whose leaves have leading dim P (stage-stacked;
+                   ``nn.scan``-style). Sharded over ``axis`` here.
+    x:             [M, mb, ...] microbatched input, replicated over
+                   ``axis`` (shard other dims via state_spec).
+    state_spec:    PartitionSpec of ONE microbatch [mb, ...] over the
+                   non-pp axes (e.g. P(('dp',), None) to ride dp);
+                   defaults to fully replicated.
+
+    Returns [M, mb, ...] outputs (replicated over ``axis``).
+    """
+    if axis not in mesh.axis_names:
+        # No pp axis: run the stages sequentially (the pipeline of one).
+        def seq(h_all):
+            n_stages = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+            for i in range(n_stages):
+                stage = jax.tree_util.tree_map(lambda w: w[i], stage_params)
+                h_all = jax.vmap(lambda h: fn(stage, h))(h_all)
+            return h_all
+
+        return seq(x)
+
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    m = x.shape[0]
+    if m < n:
+        raise ValueError(
+            f"need at least {n} microbatches to fill a {n}-stage pipeline, got {m}"
+        )
+    n_stages = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    if n_stages != n:
+        # A divisible mismatch would pass shard_map and silently run only
+        # every (n_stages/n)-th stage — fail loudly instead.
+        raise ValueError(
+            f"stage-stacked params have {n_stages} stages but the {axis!r} "
+            f"axis has {n} devices; they must match (fold extra layers "
+            f"inside fn, e.g. a lax.scan over layers-per-stage)"
+        )
+    state_spec = state_spec if state_spec is not None else P()
+    x_spec = P(None, *state_spec)  # [M, mb, ...]: microbatch dim replicated
+    params_spec = jax.tree_util.tree_map(
+        lambda _: P(axis), stage_params
+    )
+
+    def per_shard(params_me, x_all):
+        # params_me leaves keep a leading stage dim of 1 — squeeze it.
+        params_me = jax.tree_util.tree_map(lambda w: w[0], params_me)
+        i = jax.lax.axis_index(axis)
+        ticks = m + n - 1
+        outputs = jnp.zeros_like(x_all)
+        state = jnp.zeros_like(x_all[0])
+
+        def tick(carry, t):
+            state, outputs = carry
+            # Stage 0 injects microbatch t; later stages eat the permuted
+            # activation from their predecessor.
+            inj = jax.lax.dynamic_index_in_dim(
+                x_all, jnp.clip(t, 0, m - 1), axis=0, keepdims=False
+            )
+            h_in = jnp.where(i == 0, inj, state)
+            h_out = fn(params_me, h_in)
+            # Last stage banks microbatch t - (n-1) when it is real.
+            mb_idx = t - (n - 1)
+            valid_out = (i == n - 1) & (mb_idx >= 0)
+            banked = jax.lax.dynamic_update_index_in_dim(
+                outputs, h_out, jnp.clip(mb_idx, 0, m - 1), axis=0
+            )
+            outputs = jnp.where(valid_out, banked, outputs)
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            state = jax.lax.ppermute(h_out, axis, perm)
+            return (state, outputs), None
+
+        (state, outputs), _ = jax.lax.scan(
+            tick, (state, outputs), jnp.arange(ticks)
+        )
+        # Only the last stage holds real outputs; replicate over the ring.
+        return jax.lax.psum(
+            jnp.where(i == n - 1, outputs, jnp.zeros_like(outputs)), axis
+        )
+
+    return shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(params_spec, x_spec),
+        out_specs=x_spec,
+        check_vma=False,  # fn may contain pallas kernels (see ring_attention)
+    )(stage_params, x)
+
+
+def microbatch(x, microbatch_size: int):
+    """[B, ...] → [M, mb, ...] for the pipeline's leading microbatch dim."""
+    m = num_microbatches(x.shape[0], microbatch_size)
+    return x.reshape((m, microbatch_size) + x.shape[1:])
+
+
+def unmicrobatch(y):
+    """[M, mb, ...] → [B, ...]."""
+    return y.reshape((y.shape[0] * y.shape[1],) + y.shape[2:])
