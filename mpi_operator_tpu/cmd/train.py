@@ -15,9 +15,11 @@ mesh → model + shardings → orbax resume → jit train loop with step-time
 logging and optional XLA profiler trace (SURVEY.md §5 aux subsystems) →
 checkpoints → one JSON metrics line on stdout.
 
-Synthetic data throughout (the reference's headline bench is synthetic
-ImageNet too, README.md:175-206); a real input pipeline plugs in at
-``make_batch``.
+Data: synthetic by default (the reference's headline bench is synthetic
+ImageNet too, README.md:175-206); ``--data corpus.bin`` feeds LM models
+from a pre-tokenized file through the stateless Feistel-shuffled
+``data.TokenDataset`` + background ``data.Prefetcher`` (each process
+assembles exactly its rows; resume reproduces the stream bit-exactly).
 """
 
 from __future__ import annotations
@@ -53,8 +55,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resnet18|resnet50|resnet101|bert-base|bert-tiny|"
                         "llama3-8b|llama-tiny|mixtral-8x7b|llama-moe-tiny")
     p.add_argument("--mesh", default="",
-                   help="axis spec, e.g. dp=2,fsdp=4,tp=2 "
-                        "(axes: dp pp fsdp ep tp sp)")
+                   help="axis spec, e.g. dp=2,fsdp=4,tp=2 (axes: dp fsdp "
+                        "ep tp sp; pp is the parallel.run_pipeline API and "
+                        "has no stock-workload wiring yet)")
     p.add_argument("--steps", type=int, default=100,
                    help="ABSOLUTE target step: a resumed run trains only the "
                         "remainder from the latest checkpoint")
@@ -70,19 +73,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default="",
                    help="write an XLA profiler trace of steps 10-12 here")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--data", default="",
+                   help="binary uint32 token file for LM models (omit for "
+                        "synthetic data); shuffled by the stateless Feistel "
+                        "epoch permutation, so resume reproduces the stream")
+    p.add_argument("--prefetch-depth", type=int, default=2,
+                   help="batches assembled ahead of the device (with --data)")
     return p
 
 
 class Workload:
-    """A model family adapted to the trainer loop."""
+    """A model family adapted to the trainer loop.
+
+    ``batch_fn(step)``, when set, supplies a fresh batch per step (real
+    data via the prefetcher); otherwise the fixed synthetic ``batch`` is
+    reused every step."""
 
     def __init__(self, *, state: dict, step_fn: Callable, batch: tuple,
-                 examples_per_step: int, mesh):
+                 examples_per_step: int, mesh,
+                 batch_fn: Optional[Callable[[int], tuple]] = None):
         self.state = state
         self.step_fn = step_fn
         self.batch = batch
         self.examples_per_step = examples_per_step
         self.mesh = mesh
+        self.batch_fn = batch_fn
 
 
 def _resnet_workload(args, mesh, n_devices: int) -> Workload:
@@ -208,12 +223,55 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
         )
         return {"params": params, "opt_state": opt_state}, loss
 
+    batch_fn = None
+    if args.data:
+        from jax.sharding import NamedSharding
+
+        from ..data import TokenDataset
+        from ..parallel.sharding import batch_spec
+
+        is_bert = args.model.startswith("bert")
+        ds = TokenDataset(args.data, args.seq_len, seed=args.seed)
+        seq_axis = 1 if (sp > 1 and not is_bert) else None
+        sharding = NamedSharding(mesh, batch_spec(mesh, sequence_axis=seq_axis))
+        vocab = cfg.vocab_size
+
+        def to_global(rows):
+            # Each process assembled exactly its rows (the Feistel order
+            # is stateless); single-process takes the device_put shortcut.
+            if jax.process_count() == 1:
+                return jax.device_put(rows, sharding)
+            return jax.make_array_from_process_local_data(sharding, rows)
+
+        def batch_fn(step: int) -> tuple:
+            pi, pc = jax.process_index(), jax.process_count()
+            rows = ds.batch(
+                step, global_batch, process_index=pi, process_count=pc,
+            ).astype(np.int64) % vocab
+            if not is_bert:
+                return (to_global(jnp.asarray(rows, jnp.int32)),)
+            # MLM masking: drawn for the GLOBAL batch and sliced to this
+            # process's rows, so the mask of a global row is pure in
+            # (seed, step, row) — identical across any process count,
+            # which keeps resume-on-a-different-gang bit-exact (same
+            # contract as the token stream itself).
+            mrng = np.random.RandomState(args.seed + step)
+            per = global_batch // pc
+            m = (mrng.rand(global_batch, rows.shape[1]) < 0.15)[
+                pi * per:(pi + 1) * per
+            ]
+            inputs = to_global(jnp.asarray(np.where(m, 0, rows), jnp.int32))
+            mask = to_global(jnp.asarray(m, jnp.float32))
+            targets = to_global(jnp.asarray(rows, jnp.int32))
+            return (inputs, mask, targets)
+
     return Workload(
         state={"params": params, "opt_state": opt_state},
         step_fn=step_fn,
         batch=batch,
         examples_per_step=global_batch,
         mesh=mesh,
+        batch_fn=batch_fn,
     )
 
 
@@ -241,7 +299,15 @@ def main(argv=None) -> int:
     import jax
 
     devices = jax.devices()
-    mesh = create_mesh(**parse_mesh_spec(args.mesh))
+    mesh_spec = parse_mesh_spec(args.mesh)
+    if mesh_spec.get("pp", 1) != 1:
+        # No stock workload consumes pp yet: the stages would silently
+        # replicate work (1/pp of the expected throughput). Refuse loudly.
+        raise SystemExit(
+            "--mesh pp is not wired into the stock workloads; use the "
+            "parallel.run_pipeline API, or drop pp from --mesh"
+        )
+    mesh = create_mesh(**mesh_spec)
     log.info(
         "process %d/%d, %d devices, mesh %s",
         cfg.process_id, cfg.num_processes, len(devices),
@@ -287,6 +353,16 @@ def main(argv=None) -> int:
     # Always leave >= 1 timed step even on a short resume tail.
     timed_from = min(start_step + warmup, end - 1)
     tracing = False
+    batches = None
+    if work.batch_fn is not None:
+        from ..data import Prefetcher
+
+        # Background assembly + device_put overlap compute; the stateless
+        # data order means the prefetcher restarts cleanly at start_step.
+        batches = iter(
+            Prefetcher(work.batch_fn, start_step, end,
+                       depth=max(args.prefetch_depth, 1))
+        )
     with work.mesh:
         t0 = t_log = None
         step = last_log_step = start_step
@@ -298,7 +374,8 @@ def main(argv=None) -> int:
             if args.profile_dir and step == timed_from + 10:
                 jax.profiler.start_trace(args.profile_dir)
                 tracing = True
-            work.state, loss = work.step_fn(work.state, work.batch)
+            batch = next(batches)[1] if batches is not None else work.batch
+            work.state, loss = work.step_fn(work.state, batch)
             step += 1
             if tracing and step == timed_from + 13:
                 jax.block_until_ready(loss)

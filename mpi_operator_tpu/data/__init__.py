@@ -1,0 +1,12 @@
+"""Input pipelines for TPUJob workloads.
+
+The reference delegates data loading to the user image (tf.data / torch
+DataLoader); this framework ships its own, designed around the SPMD
+world the operator creates: a stateless Feistel-permutation shuffle (any
+worker derives its shard of any step in O(1), resume = a step number), a
+native mmap'd batch assembler with a wire-identical Python fallback, and
+a device prefetcher that overlaps host batch assembly with TPU compute.
+"""
+
+from .loader import Prefetcher, TokenDataset, write_token_file  # noqa: F401
+from .permutation import feistel_permute  # noqa: F401

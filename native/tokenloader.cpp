@@ -1,0 +1,157 @@
+// TPUJob token-data loader — native runtime component.
+//
+// Role: the hot host-side path of LM input pipelines — random-access
+// shuffled batch assembly out of an mmap'd token file. The reference
+// delegates data loading entirely to user containers (its examples use
+// tf.data / torch DataLoader inside the image); here the framework owns
+// it, designed for the SPMD world the operator creates:
+//
+//   * The shuffle is a FEISTEL PERMUTATION: a 4-round balanced Feistel
+//     network over [0, N) (cycle-walking to handle non-power-of-4 N)
+//     keyed by (seed). That makes the epoch order a stateless bijection:
+//     ANY worker can compute sequence index -> shuffled position in O(1)
+//     with no shared index array, no coordination, and resume needs only
+//     the step number — the data-order analog of the operator's
+//     zero-apiserver-request worker startup.
+//   * The token file is mmap'd read-only; batch assembly is memcpy per
+//     sequence, so the page cache (not Python) does the buffering.
+//
+// Wire contract shared with the pure-Python fallback
+// (mpi_operator_tpu/data/permutation.py): identical mix64/Feistel
+// constants — a batch produced natively and one produced in Python are
+// byte-identical. The fallback keeps the loader dependency-free; this
+// library is an optimization, never a requirement (same pattern as
+// native/barrier.cpp).
+//
+// Build: make -C native   ->  libtpujob_tokenloader.so
+
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kGolden = 0x9E3779B97F4A7C15ULL;
+
+inline uint64_t mix64(uint64_t z) {
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+// Balanced Feistel over 2*b bits with cycle-walking down to [0, n).
+struct Feistel {
+  uint64_t n;
+  int half_bits;
+  uint64_t mask;
+  uint64_t keys[4];
+
+  Feistel(uint64_t n_, uint64_t seed) : n(n_) {
+    int bl = 0;
+    for (uint64_t v = (n_ > 1 ? n_ - 1 : 1); v; v >>= 1) bl++;
+    half_bits = (bl + 1) / 2;
+    if (half_bits < 1) half_bits = 1;
+    mask = (1ULL << half_bits) - 1ULL;
+    for (int r = 0; r < 4; r++) {
+      keys[r] = mix64(seed + kGolden * static_cast<uint64_t>(r + 1));
+    }
+  }
+
+  uint64_t encrypt_once(uint64_t v) const {
+    uint64_t l = v >> half_bits, r = v & mask;
+    for (int i = 0; i < 4; i++) {
+      uint64_t nr = l ^ (mix64(r ^ keys[i]) & mask);
+      l = r;
+      r = nr;
+    }
+    return (l << half_bits) | r;
+  }
+
+  uint64_t permute(uint64_t i) const {
+    if (n <= 1) return 0;
+    uint64_t v = encrypt_once(i);
+    while (v >= n) v = encrypt_once(v);  // cycle-walk: still a bijection
+    return v;
+  }
+};
+
+struct Loader {
+  int fd = -1;
+  const uint32_t* tokens = nullptr;
+  size_t file_bytes = 0;
+  int64_t seq_len = 0;
+  int64_t num_sequences = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Exposed for wire-parity tests against the Python fallback.
+unsigned long long tpujob_tl_permute(unsigned long long n,
+                                     unsigned long long seed,
+                                     unsigned long long i) {
+  return Feistel(n, seed).permute(i);
+}
+
+void* tpujob_tl_open(const char* path, long long seq_len) {
+  if (seq_len <= 0) return nullptr;
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (::fstat(fd, &st) != 0 || st.st_size < static_cast<off_t>(4 * seq_len)) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = ::mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (mem == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  Loader* h = new Loader();
+  h->fd = fd;
+  h->tokens = static_cast<const uint32_t*>(mem);
+  h->file_bytes = st.st_size;
+  h->seq_len = seq_len;
+  h->num_sequences = st.st_size / (4 * seq_len);  // remainder truncated
+  return h;
+}
+
+long long tpujob_tl_num_sequences(void* handle) {
+  return handle ? static_cast<Loader*>(handle)->num_sequences : 0;
+}
+
+// Fill `count` sequences starting at shuffled-epoch position `start`:
+// out[j] = tokens[perm(start + j)] for j in [0, count). Positions wrap
+// around the epoch (callers advance `seed` per epoch). Returns 0 on
+// success.
+int tpujob_tl_fill(void* handle, unsigned long long seed, long long start,
+                   long long count, unsigned int* out) {
+  if (!handle || start < 0 || count <= 0 || !out) return 1;
+  Loader* h = static_cast<Loader*>(handle);
+  Feistel f(static_cast<uint64_t>(h->num_sequences), seed);
+  for (long long j = 0; j < count; j++) {
+    uint64_t pos = static_cast<uint64_t>(start + j) %
+                   static_cast<uint64_t>(h->num_sequences);
+    uint64_t src = f.permute(pos);
+    std::memcpy(out + j * h->seq_len, h->tokens + src * h->seq_len,
+                4 * h->seq_len);
+  }
+  return 0;
+}
+
+void tpujob_tl_close(void* handle) {
+  if (!handle) return;
+  Loader* h = static_cast<Loader*>(handle);
+  if (h->tokens) {
+    ::munmap(const_cast<uint32_t*>(h->tokens), h->file_bytes);
+  }
+  if (h->fd >= 0) ::close(h->fd);
+  delete h;
+}
+
+}  // extern "C"

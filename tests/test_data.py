@@ -1,0 +1,164 @@
+"""Data subsystem: Feistel shuffle, token dataset (native + fallback),
+process-split composition, resume determinism, prefetcher.
+"""
+
+import numpy as np
+import pytest
+
+from mpi_operator_tpu.data import (
+    Prefetcher,
+    TokenDataset,
+    feistel_permute,
+    write_token_file,
+)
+from mpi_operator_tpu.data.loader import _load_native
+
+NATIVE = _load_native()
+
+
+@pytest.fixture
+def token_file(tmp_path):
+    # 64 sequences of 16 tokens; sequence i is [i*16, i*16+16) so a row's
+    # first token identifies its source sequence.
+    path = tmp_path / "tokens.bin"
+    write_token_file(path, np.arange(64 * 16, dtype=np.uint32))
+    return path
+
+
+class TestFeistel:
+    @pytest.mark.parametrize("n", [1, 2, 3, 16, 100, 1023])
+    @pytest.mark.parametrize("seed", [0, 7])
+    def test_bijection(self, n, seed):
+        out = [feistel_permute(n, seed, i) for i in range(n)]
+        assert sorted(out) == list(range(n))
+
+    def test_seed_changes_order(self):
+        a = [feistel_permute(100, 1, i) for i in range(100)]
+        b = [feistel_permute(100, 2, i) for i in range(100)]
+        assert a != b
+
+    @pytest.mark.skipif(NATIVE is None, reason="native lib not built")
+    def test_native_wire_parity(self):
+        for n in (5, 64, 1000):
+            for seed in (0, 99):
+                for i in range(min(n, 64)):
+                    assert NATIVE.tpujob_tl_permute(n, seed, i) == (
+                        feistel_permute(n, seed, i)
+                    ), (n, seed, i)
+
+
+class TestTokenDataset:
+    def test_epoch_covers_every_sequence_once(self, token_file):
+        ds = TokenDataset(token_file, 16, use_native=False)
+        rows = ds.fill(epoch=0, start=0, count=64)
+        firsts = sorted(int(r[0]) // 16 for r in rows)
+        assert firsts == list(range(64))
+
+    def test_rows_are_contiguous_sequences(self, token_file):
+        ds = TokenDataset(token_file, 16, use_native=False)
+        row = ds.fill(epoch=0, start=3, count=1)[0]
+        np.testing.assert_array_equal(
+            row, np.arange(row[0], row[0] + 16, dtype=np.uint32)
+        )
+
+    def test_batch_is_deterministic_resume(self, token_file):
+        ds = TokenDataset(token_file, 16, use_native=False)
+        again = TokenDataset(token_file, 16, use_native=False)
+        for step in (0, 3, 17):
+            np.testing.assert_array_equal(
+                ds.batch(step, 8), again.batch(step, 8)
+            )
+
+    def test_process_split_composes_to_global(self, token_file):
+        ds = TokenDataset(token_file, 16, use_native=False)
+        full = ds.batch(2, 8)
+        parts = [
+            ds.batch(2, 8, process_index=i, process_count=4) for i in range(4)
+        ]
+        np.testing.assert_array_equal(np.concatenate(parts), full)
+
+    def test_epoch_boundary_reshuffles(self, token_file):
+        ds = TokenDataset(token_file, 16, use_native=False)
+        # 64 sequences / batch 8 -> 8 steps per epoch.
+        epoch0 = np.concatenate([ds.batch(s, 8) for s in range(8)])
+        epoch1 = np.concatenate([ds.batch(s, 8) for s in range(8, 16)])
+        ids0 = sorted(int(r[0]) // 16 for r in epoch0)
+        ids1 = sorted(int(r[0]) // 16 for r in epoch1)
+        assert ids0 == ids1 == list(range(64))  # full coverage both epochs
+        assert not np.array_equal(epoch0, epoch1)  # different order
+
+    def test_batch_straddling_epoch_boundary(self, token_file):
+        ds = TokenDataset(token_file, 16, use_native=False)
+        # global positions [60, 70): 4 rows of epoch 0 + 6 of epoch 1
+        batch = ds.batch(6, 10)
+        assert batch.shape == (10, 16)
+
+    def test_batch_larger_than_corpus_walks_multiple_epochs(self, token_file):
+        # 64-sequence corpus, one 160-row batch = 2.5 epochs: every epoch
+        # segment must use its own permutation seed (no duplicated rows
+        # from reusing epoch+1's order for the wrap).
+        ds = TokenDataset(token_file, 16, use_native=False)
+        big = ds.batch(0, 160)
+        assert big.shape == (160, 16)
+        e0 = [int(r[0]) // 16 for r in big[:64]]
+        e1 = [int(r[0]) // 16 for r in big[64:128]]
+        e2_half = [int(r[0]) // 16 for r in big[128:]]
+        assert sorted(e0) == sorted(e1) == list(range(64))
+        assert e0 != e1  # epoch 1 reshuffled
+        # third segment is the PREFIX of epoch 2's order, not epoch 1's
+        assert e2_half != e1[:32]
+
+    @pytest.mark.skipif(NATIVE is None, reason="native lib not built")
+    def test_native_and_fallback_batches_identical(self, token_file):
+        nat = TokenDataset(token_file, 16)
+        pyf = TokenDataset(token_file, 16, use_native=False)
+        assert nat.native and not pyf.native
+        for step in (0, 5, 11):
+            np.testing.assert_array_equal(
+                nat.batch(step, 8), pyf.batch(step, 8)
+            )
+        nat.close()
+
+    def test_too_small_file_rejected(self, tmp_path):
+        path = tmp_path / "tiny.bin"
+        write_token_file(path, np.arange(4, dtype=np.uint32))
+        with pytest.raises(ValueError, match="smaller than one"):
+            TokenDataset(path, 16, use_native=False)
+
+    def test_indivisible_process_count_rejected(self, token_file):
+        ds = TokenDataset(token_file, 16, use_native=False)
+        with pytest.raises(ValueError, match="not divisible"):
+            ds.batch(0, 8, process_count=3)
+
+
+class TestPrefetcher:
+    def test_yields_all_steps_in_order(self):
+        seen = list(Prefetcher(lambda s: s * 10, 3, 9, depth=2))
+        assert seen == [(s, s * 10) for s in range(3, 9)]
+
+    def test_propagates_worker_errors(self):
+        def boom(step):
+            if step == 2:
+                raise RuntimeError("assembly failed")
+            return step
+
+        it = iter(Prefetcher(boom, 0, 5, depth=1))
+        assert next(it) == (0, 0)
+        assert next(it) == (1, 1)
+        with pytest.raises(RuntimeError, match="assembly failed"):
+            list(it)
+
+    def test_overlaps_assembly(self):
+        import time
+
+        calls = []
+
+        def slow(step):
+            calls.append(step)
+            time.sleep(0.02)
+            return step
+
+        pf = Prefetcher(slow, 0, 4, depth=2)
+        time.sleep(0.08)  # worker should have run ahead without consumption
+        assert len(calls) >= 2
+        assert [s for s, _ in pf] == [0, 1, 2, 3]

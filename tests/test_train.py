@@ -60,6 +60,51 @@ class TestTrainModels:
         assert m["devices"] == 8
 
 
+class TestRealDataTraining:
+    def test_llama_tiny_trains_from_token_file(self, capsys, tmp_path):
+        import numpy as np
+
+        from mpi_operator_tpu.data import write_token_file
+
+        path = tmp_path / "corpus.bin"
+        write_token_file(
+            path, np.random.RandomState(0).randint(
+                0, 250, size=64 * 32).astype(np.uint32),
+        )
+        m = run_train(
+            capsys, "--model", "llama-tiny", "--steps", "3", "--warmup", "1",
+            "--global-batch", "8", "--seq-len", "32", "--log-every", "0",
+            "--data", str(path),
+        )
+        assert m["final_step"] == 3 and m["loss"] is not None
+
+    def test_bert_tiny_trains_from_token_file(self, capsys, tmp_path):
+        import numpy as np
+
+        from mpi_operator_tpu.data import write_token_file
+
+        path = tmp_path / "corpus.bin"
+        write_token_file(
+            path, np.random.RandomState(1).randint(
+                0, 120, size=64 * 32).astype(np.uint32),
+        )
+        m = run_train(
+            capsys, "--model", "bert-tiny", "--steps", "3", "--warmup", "1",
+            "--global-batch", "8", "--seq-len", "32", "--log-every", "0",
+            "--data", str(path),
+        )
+        assert m["final_step"] == 3 and m["loss"] is not None
+
+
+class TestMeshGuards:
+    def test_pp_mesh_rejected_by_stock_workloads(self, capsys):
+        with pytest.raises(SystemExit, match="run_pipeline"):
+            train_cmd.main([
+                "--model", "llama-tiny", "--steps", "1",
+                "--mesh", "dp=2,pp=4",
+            ])
+
+
 class TestCheckpointResume:
     def test_resume_continues_to_absolute_target(self, capsys, tmp_path):
         ckpt = str(tmp_path / "ckpt")
