@@ -346,13 +346,47 @@ def main(argv=None) -> int:
         print(json.dumps({
             "model": args.model, "steps": 0, "final_step": start_step,
             "loss": None, "examples_per_sec": 0.0, "step_ms": 0.0,
-            "devices": len(devices),
+            "devices": len(devices), "preempted": False,
         }))
         return 0
     warmup = max(args.warmup, 1)
     # Always leave >= 1 timed step even on a short resume tail.
     timed_from = min(start_step + warmup, end - 1)
     tracing = False
+    # Preemption-aware shutdown: TPU slices are reclaimed with SIGTERM +
+    # a grace window (the operator's pods inherit kubelet semantics).
+    # Instead of dying mid-step and burning a restart on stale progress,
+    # finish the current step, checkpoint, and exit 0 - the controller's
+    # OnFailure/elastic path then restarts the gang from that exact step
+    # (and --steps being absolute means no work is repeated).
+    import signal
+    import threading
+
+    preempted = threading.Event()
+
+    def _on_sigterm(signum, frame):
+        log.warning("SIGTERM: checkpointing at the next step boundary")
+        preempted.set()
+
+    prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+
+    # Multi-host gangs must AGREE on the stop step: orbax saves are
+    # collective, so one process breaking at step k while another breaks
+    # at k+1 wedges the gang inside the checkpoint. A one-element
+    # allgather of the local flag each step keeps the decision global
+    # (SIGTERM lands on every pod within the same grace window, so the
+    # gang converges within one step).
+    sync_preempt = None
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        import numpy as _np
+
+        def sync_preempt(local: bool) -> bool:
+            return bool(
+                multihost_utils.process_allgather(_np.array([local])).max()
+            )
+
     batches = None
     if work.batch_fn is not None:
         from ..data import Prefetcher
@@ -394,20 +428,35 @@ def main(argv=None) -> int:
                     log.info("step %d: loss=%.4f (warmup)", step, float(loss))
             if ckpt is not None:
                 ckpt.save(step, work.state)
+            stop_now = preempted.is_set()
+            if sync_preempt is not None:
+                stop_now = sync_preempt(stop_now)
+                if stop_now:
+                    preempted.set()  # reflect the gang decision locally
+            if stop_now:
+                # The post-loop force-save commits this exact step.
+                log.warning("preemption: stopping at step %d", step)
+                break
         jax.block_until_ready(loss)
         if tracing:  # run ended inside the trace window
             jax.profiler.stop_trace()
             log.info("profiler trace written to %s", args.profile_dir)
-        elapsed = time.perf_counter() - t0
-        timed_steps = end - timed_from
+        # Preemption can land before the timed window opened.
+        timed_steps = max(step - timed_from, 0)
+        elapsed = (time.perf_counter() - t0) if t0 is not None else 0.0
         final_loss = float(loss)
 
     if ckpt is not None:
         ckpt.save(step, work.state, force=True)
         ckpt.wait_until_finished()
         ckpt.close()
+    # Only after the checkpoint is durable: a second SIGTERM during the
+    # commit must not kill the process mid-write.
+    signal.signal(signal.SIGTERM, prev_handler)
 
-    examples_per_sec = work.examples_per_step * timed_steps / elapsed
+    examples_per_sec = (
+        work.examples_per_step * timed_steps / elapsed if elapsed > 0 else 0.0
+    )
     print(
         json.dumps(
             {
@@ -416,8 +465,12 @@ def main(argv=None) -> int:
                 "final_step": step,
                 "loss": final_loss,
                 "examples_per_sec": round(examples_per_sec, 2),
-                "step_ms": round(elapsed / timed_steps * 1000, 2),
+                "step_ms": (
+                    round(elapsed / timed_steps * 1000, 2)
+                    if timed_steps else 0.0
+                ),
                 "devices": len(devices),
+                "preempted": preempted.is_set(),
             }
         )
     )
