@@ -79,6 +79,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "epoch permutation, so resume reproduces the stream")
     p.add_argument("--prefetch-depth", type=int, default=2,
                    help="batches assembled ahead of the device (with --data)")
+    p.add_argument("--zigzag-ring", action="store_true",
+                   help="balance causal ring-attention work with the zigzag "
+                        "sequence layout (llama + sp meshes; --seq-len must "
+                        "divide by 2*sp)")
     return p
 
 
@@ -188,14 +192,15 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
         from ..models import llama as lib
 
         attention = "ring" if sp > 1 else "flash"
+        zigzag = bool(args.zigzag_ring and sp > 1)
         if args.model == "llama3-8b":
-            cfg = lib.llama3_8b(attention_impl=attention)
+            cfg = lib.llama3_8b(attention_impl=attention, zigzag_ring=zigzag)
         elif args.model == "mixtral-8x7b":
-            cfg = lib.mixtral_8x7b(attention_impl=attention)
+            cfg = lib.mixtral_8x7b(attention_impl=attention, zigzag_ring=zigzag)
         elif args.model == "llama-moe-tiny":
-            cfg = lib.tiny_moe(attention_impl=attention)
+            cfg = lib.tiny_moe(attention_impl=attention, zigzag_ring=zigzag)
         else:
-            cfg = lib.tiny(attention_impl=attention)
+            cfg = lib.tiny(attention_impl=attention, zigzag_ring=zigzag)
         model = lib.Llama(cfg, mesh=mesh)
         with mesh:
             params = lib.init_params(

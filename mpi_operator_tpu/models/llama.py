@@ -49,6 +49,16 @@ class LlamaConfig:
     # 'flash' (pallas kernel), 'dense' (XLA reference), or 'ring'
     # (sequence-parallel over the sp mesh axis; requires mesh context).
     attention_impl: str = "flash"
+    # With ring attention: lay the sequence out zigzag (device i holds
+    # chunks i and 2n-1-i) so causal work balances across the ring. The
+    # model permutes after the embedding and unpermutes before the head;
+    # RoPE sees the true positions, so dense configs compute exactly
+    # standard attention — only the layout (and the ring's load)
+    # changes. MoE configs are the one caveat: WHICH tokens drop when an
+    # expert overflows capacity follows token order (moe.py's cumsum
+    # slotting), so under overflow a zigzag run drops a different —
+    # equally arbitrary — token set than a contiguous run.
+    zigzag_ring: bool = False
     # Sparse MoE FFN (models/moe.py): 0 = dense SwiGLU; > 0 replaces every
     # block's MLP with n_experts experts routed top-k, experts sharded
     # over the ep mesh axis. The train loss adds router_aux_coef × the
@@ -120,6 +130,15 @@ class RMSNorm(nn.Module):
         return (norm * scale).astype(x.dtype)
 
 
+def _use_zigzag(cfg: "LlamaConfig", mesh) -> bool:
+    """The ONE decision for zigzag layout — the model-level permute and
+    the per-layer ring call must always agree."""
+    if not (cfg.attention_impl == "ring" and cfg.zigzag_ring and mesh is not None):
+        return False
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return sizes.get(SP, 1) > 1
+
+
 class Attention(nn.Module):
     config: LlamaConfig
     mesh: Optional[Any] = None  # required for attention_impl='ring'
@@ -153,7 +172,10 @@ class Attention(nn.Module):
         elif cfg.attention_impl == "ring":
             if self.mesh is None or SP not in self.mesh.axis_names:
                 raise ValueError("attention_impl='ring' needs a mesh with an sp axis")
-            out = ring_attention_shard_mapped(q, k, v, self.mesh, causal=True)
+            out = ring_attention_shard_mapped(
+                q, k, v, self.mesh, causal=True,
+                zigzag=_use_zigzag(cfg, self.mesh),
+            )
         else:
             out = attention_reference(q, k, v, causal=True)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
@@ -217,6 +239,22 @@ class Llama(nn.Module):
             name="embed",
         )
         h = emb(tokens)
+        # Zigzag ring layout: permute the sequence once after the
+        # embedding (device i ends up holding chunks i and 2n-1-i of the
+        # sp ring) and hand RoPE the TRUE positions of the permuted rows;
+        # every non-attention op is pointwise over sequence, so only the
+        # two permutes at the model's edges and the balanced ring differ
+        # from the contiguous layout.
+        unperm = None
+        if _use_zigzag(cfg, self.mesh):
+            from ..ops.ring_attention import zigzag_indices, zigzag_inverse
+
+            n = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))[SP]
+            seq = tokens.shape[1]
+            perm = jnp.asarray(zigzag_indices(seq, n))
+            unperm = jnp.asarray(zigzag_inverse(seq, n))
+            h = h[:, perm]
+            positions = jnp.broadcast_to(perm, tokens.shape)
         block = Block
         if cfg.remat:
             block = nn.remat(Block, static_argnums=())
@@ -225,6 +263,8 @@ class Llama(nn.Module):
             h, aux = block(cfg, self.mesh, name=f"layer_{i}")(h, positions)
             aux_total = aux_total + aux
         h = RMSNorm(cfg.norm_eps, name="final_norm")(h)
+        if unperm is not None:
+            h = h[:, unperm]  # back to natural order for the LM head/loss
         # Untied lm_head (Llama-3 does not tie embeddings); f32 logits for
         # a stable softmax-CE.
         if cfg.tie_embeddings:
