@@ -43,6 +43,8 @@ def _timed_steps(step, state, args_rest, steps: int, warmup: int):
     for _ in range(warmup):
         state = step(*state, *args_rest)
     jax.block_until_ready(state)
+    if steps == 0:  # warmup-only call (profiling path)
+        return state, float("nan")
     t0 = time.perf_counter()
     for _ in range(steps):
         state = step(*state, *args_rest)

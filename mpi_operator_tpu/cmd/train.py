@@ -83,6 +83,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="balance causal ring-attention work with the zigzag "
                         "sequence layout (llama + sp meshes; --seq-len must "
                         "divide by 2*sp)")
+    p.add_argument("--sequence-parallel", choices=["ring", "ulysses"],
+                   default="ring",
+                   help="long-context strategy on sp>1 meshes: 'ring' "
+                        "(ppermute k/v ring, O(S/n) activation residency, "
+                        "any sp size) or 'ulysses' (two all-to-alls + "
+                        "head-sharded flash; sp must divide the head count)")
     return p
 
 
@@ -191,8 +197,10 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
     else:
         from ..models import llama as lib
 
-        attention = "ring" if sp > 1 else "flash"
-        zigzag = bool(args.zigzag_ring and sp > 1)
+        attention = args.sequence_parallel if sp > 1 else "flash"
+        zigzag = bool(
+            args.zigzag_ring and sp > 1 and attention == "ring"
+        )
         if args.model == "llama3-8b":
             cfg = lib.llama3_8b(attention_impl=attention, zigzag_ring=zigzag)
         elif args.model == "mixtral-8x7b":

@@ -46,8 +46,11 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     tie_embeddings: bool = False
-    # 'flash' (pallas kernel), 'dense' (XLA reference), or 'ring'
-    # (sequence-parallel over the sp mesh axis; requires mesh context).
+    # 'flash' (pallas kernel), 'dense' (XLA reference), 'ring'
+    # (sequence-parallel ppermute ring over the sp mesh axis), or
+    # 'ulysses' (sequence-parallel via two all-to-alls over sp:
+    # head-sharded full-sequence flash between them; sp must divide the
+    # head count). 'ring'/'ulysses' require a mesh.
     attention_impl: str = "flash"
     # With ring attention: lay the sequence out zigzag (device i holds
     # chunks i and 2n-1-i) so causal work balances across the ring. The
@@ -141,7 +144,7 @@ def _use_zigzag(cfg: "LlamaConfig", mesh) -> bool:
 
 class Attention(nn.Module):
     config: LlamaConfig
-    mesh: Optional[Any] = None  # required for attention_impl='ring'
+    mesh: Optional[Any] = None  # required for attention_impl='ring'/'ulysses'
 
     @nn.compact
     def __call__(self, x, positions):
@@ -176,6 +179,14 @@ class Attention(nn.Module):
                 q, k, v, self.mesh, causal=True,
                 zigzag=_use_zigzag(cfg, self.mesh),
             )
+        elif cfg.attention_impl == "ulysses":
+            if self.mesh is None or SP not in self.mesh.axis_names:
+                raise ValueError(
+                    "attention_impl='ulysses' needs a mesh with an sp axis"
+                )
+            from ..ops.ulysses import ulysses_attention_shard_mapped
+
+            out = ulysses_attention_shard_mapped(q, k, v, self.mesh, causal=True)
         else:
             out = attention_reference(q, k, v, causal=True)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)

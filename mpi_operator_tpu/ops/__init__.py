@@ -2,9 +2,11 @@
 
 The reference operator contains no kernels (it orchestrates user MPI
 programs); this layer is where our framework's *workload* half earns the
-"TPU-native" name: flash attention on the MXU via pallas, and ring
-attention over an ``sp`` mesh axis for long-context training (flash
-per-hop partials merged by logsumexp, zigzag layout for causal balance).
+"TPU-native" name: flash attention on the MXU via pallas, and two
+sequence-parallel strategies over an ``sp`` mesh axis for long-context
+training — ring attention (flash per-hop partials merged by logsumexp,
+zigzag layout for causal balance) and Ulysses all-to-all (head-sharded
+full-sequence flash between two ICI all-to-alls).
 """
 
 from .attention import attention_reference, flash_attention, flash_attention_lse
@@ -14,6 +16,7 @@ from .ring_attention import (
     zigzag_indices,
     zigzag_inverse,
 )
+from .ulysses import ulysses_attention, ulysses_attention_sharded
 
 __all__ = [
     "attention_reference",
@@ -21,6 +24,8 @@ __all__ = [
     "flash_attention_lse",
     "ring_attention",
     "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
     "zigzag_indices",
     "zigzag_inverse",
 ]

@@ -1,0 +1,185 @@
+"""Ulysses attention: all-to-all sequence/context parallelism.
+
+The second of the two standard long-context strategies (the first, ring
+attention, is ``ops.ring_attention``). Where the ring streams k/v shards
+around the ``sp`` axis with nearest-neighbor ``ppermute`` hops, Ulysses
+re-shards *once* per attention call: activations arrive sequence-sharded
+``[B, H, S/n, D]``, an all-to-all over ``sp`` turns them head-sharded
+``[B, H/n, S, D]``, each device runs ordinary full-sequence flash
+attention over its head slice, and a second all-to-all restores the
+sequence sharding. Two collectives per call, each moving ``1/n`` of the
+activations — on a TPU torus these lower to XLA ``AllToAll`` over ICI.
+
+Trade-off vs the ring (why the framework ships both):
+
+- Ulysses does the attention math as ONE dense flash call per device —
+  no per-hop launch overhead, no logsumexp merges, and causal masking is
+  the standard aligned mask, so there is no load-balance problem and no
+  need for zigzag layouts.
+- But its parallelism is capped by the head count (``n`` must divide
+  ``H``, and for GQA the kv heads are replicated up to ``lcm(H_kv, n)``),
+  and every device holds a full-length [S] row of activations during the
+  call — the ring's O(S/n) activation residency is what scales to
+  million-token contexts. Ulysses is the right tool up to moderate
+  sequence lengths and sp degrees; the ring takes over beyond them.
+
+The reference has no analog for either (its operator hands out ranks and
+user MPI programs own the math — SURVEY.md §2.4, "TP/SP/ring-attention:
+absent, delegated to user programs"). Pattern reference: DeepSpeed-
+Ulysses (arXiv:2309.14509).
+
+Differentiable end-to-end: ``lax.all_to_all`` has a transpose rule (its
+own inverse all-to-all) and the flash kernel carries a custom VJP.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.mesh import SP
+from .attention import attention_reference, flash_attention
+from .ring_attention import ring_spec
+
+
+def _replicate_kv_for(h_kv: int, n: int):
+    """Smallest per-head repeat factor r such that n divides h_kv * r."""
+    return n // math.gcd(h_kv, n)
+
+
+def ulysses_attention(
+    q, k, v,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    impl: str = "flash",
+):
+    """Per-shard Ulysses attention — call inside shard_map/pmap.
+
+    q: [B, H, S_local, D]; k, v: [B, H_kv, S_local, D], sequence-sharded
+    contiguously over ``axis_name`` (device i holds rows
+    [i·S_local, (i+1)·S_local)). Returns the local output shard in the
+    layout of q.
+
+    Head divisibility: ``n = size(axis_name)`` must divide H. GQA kv
+    heads are repeated in-graph up to ``lcm(H_kv, n)`` when n does not
+    divide H_kv — the repeat happens *before* the all-to-all, so each
+    device still only ever materializes its 1/n slice of the (repeated)
+    kv heads at full sequence length.
+    """
+    if impl not in ("flash", "dense"):
+        raise ValueError(f"impl must be 'flash' or 'dense', got {impl!r}")
+    n = jax.lax.axis_size(axis_name)
+    h, h_kv = q.shape[1], k.shape[1]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    if h % n:
+        raise ValueError(
+            f"ulysses needs the sp size ({n}) to divide the query head "
+            f"count ({h}); use ring attention for sp > heads"
+        )
+    if h_kv % n:
+        rep = _replicate_kv_for(h_kv, n)
+        # lcm(h_kv, n) divides h because both h_kv and n do.
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+
+    if n > 1:
+        # Sequence-sharded -> head-sharded: [B, H, S/n, D] -> [B, H/n, S, D].
+        # tiled all-to-all concatenates device j's rows at offset j·S_local,
+        # which is exactly the contiguous sequence order.
+        a2a = lambda x: jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+        q, k, v = a2a(q), a2a(k), a2a(v)
+
+    if impl == "flash":
+        out = flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    else:
+        groups = q.shape[1] // k.shape[1]
+        if groups > 1:
+            k = jnp.repeat(k, groups, axis=1)
+            v = jnp.repeat(v, groups, axis=1)
+        out = attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+
+    if n > 1:
+        # Head-sharded -> sequence-sharded: [B, H/n, S, D] -> [B, H, S/n, D].
+        out = jax.lax.all_to_all(
+            out, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+    return out
+
+
+def ulysses_attention_shard_mapped(
+    q, k, v,
+    mesh,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    axis: str = SP,
+    impl: str = "flash",
+):
+    """shard_map the per-shard Ulysses kernel over the mesh — composable
+    inside a larger jitted computation (models call this directly).
+
+    Operand layout is the same as ring attention's (``ring_spec``): batch
+    over dp×fsdp, heads over tp when divisible, sequence over ``axis`` —
+    so models can switch between ring and Ulysses without re-sharding.
+    With a tp axis, each tp group runs an independent Ulysses exchange
+    over its head slice; the sp size must then divide H/tp.
+    """
+    from jax import shard_map
+
+    hq, hkv = q.shape[1], k.shape[1]
+    tp_heads = (
+        hq if (ring_spec(mesh, axis, hq)[1] is not None
+               and ring_spec(mesh, axis, hkv)[1] is not None)
+        else None
+    )
+    q_spec = ring_spec(mesh, axis, tp_heads)
+    kv_spec = ring_spec(mesh, axis, hkv if tp_heads else None)
+    fn = shard_map(
+        lambda a, b, c: ulysses_attention(
+            a, b, c, axis, causal=causal, sm_scale=sm_scale, impl=impl
+        ),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+        # Same vma workaround as ring_attention_shard_mapped: pallas in
+        # shard_map trips jax's varying-manual-axes tracking in interpret
+        # mode; correctness is covered by the dense-oracle tests.
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def ulysses_attention_sharded(
+    q, k, v,
+    mesh,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    axis: str = SP,
+    impl: str = "flash",
+):
+    """Global-view Ulysses attention: jit + placement around
+    ``ulysses_attention_shard_mapped`` for standalone use. Inputs are
+    global [B, H, S, D] arrays with S divisible by the sp axis size."""
+    if axis not in mesh.axis_names:
+        return None  # caller should fall back to dense attention
+    spec = ring_spec(mesh, axis)
+
+    @jax.jit
+    def run(q, k, v):
+        q_, k_, v_ = (jax.lax.with_sharding_constraint(x, spec) for x in (q, k, v))
+        return ulysses_attention_shard_mapped(
+            q_, k_, v_, mesh, causal=causal, sm_scale=sm_scale, axis=axis,
+            impl=impl,
+        )
+
+    with mesh:
+        return run(q, k, v)

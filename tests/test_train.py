@@ -59,6 +59,15 @@ class TestTrainModels:
         assert m["final_step"] == 3
         assert m["devices"] == 8
 
+    def test_llama_tiny_ulysses_sequence_parallel(self, capsys):
+        m = run_train(
+            capsys, "--model", "llama-tiny", "--steps", "3", "--warmup", "1",
+            "--mesh", "dp=2,sp=4", "--sequence-parallel", "ulysses",
+            "--global-batch", "4", "--seq-len", "32", "--log-every", "0",
+        )
+        assert m["final_step"] == 3
+        assert m["devices"] == 8
+
 
 class TestRealDataTraining:
     def test_llama_tiny_trains_from_token_file(self, capsys, tmp_path):
