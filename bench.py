@@ -34,22 +34,89 @@ def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
+def _sync(state):
+    """Host readback barrier. ``jax.block_until_ready`` is NOT a reliable
+    fence on remote-tunnel platforms (the axon TPU backend returns from it
+    before the device finishes), so pull one element of one leaf to the
+    host — the transfer cannot complete before the producing computation.
+    """
+    import jax
+    import numpy as np
+
+    leaf = jax.tree_util.tree_leaves(state)[0]
+    np.asarray(leaf.ravel()[:1])
+
+
 def _timed_steps(step, state, args_rest, steps: int, warmup: int):
     """Run `warmup` untimed (callers pass >=1 unless already compiled)
-    then `steps` timed invocations of state = step(*state, *args_rest);
-    returns (state, seconds/step)."""
-    import jax
+    then timed invocations of state = step(*state, *args_rest); returns
+    (state, seconds/step).
 
+    Timing discipline: the axon tunnel adds a fixed completion-latency
+    quantum (~100 ms, variance ~±15 ms) to every host-visible sync, so a
+    single timed window over-reports short steps badly. Two windows of
+    different lengths are timed instead and the DIFFERENCE quotient
+    reported — the fixed quantum cancels:
+        sec = (T(n2) - T(n1)) / (n2 - n1)
+    On honest platforms this is identical to plain timing (both windows
+    end in a readback barrier, which costs microseconds locally).
+    """
     for _ in range(warmup):
         state = step(*state, *args_rest)
-    jax.block_until_ready(state)
+    _sync(state)
     if steps == 0:  # warmup-only call (profiling path)
         return state, float("nan")
+    if steps < 4:  # too short for two windows; single window + barrier
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state = step(*state, *args_rest)
+        _sync(state)
+        return state, (time.perf_counter() - t0) / steps
+    n1 = max(steps // 4, 1)
     t0 = time.perf_counter()
+    for _ in range(n1):
+        state = step(*state, *args_rest)
+    _sync(state)
+    t1 = time.perf_counter()
     for _ in range(steps):
         state = step(*state, *args_rest)
-    jax.block_until_ready(state)
-    return state, (time.perf_counter() - t0) / steps
+    _sync(state)
+    t2 = time.perf_counter()
+    sec = ((t2 - t1) - (t1 - t0)) / (steps - n1)
+    if sec <= 0:  # noise floor: both windows were all fixed overhead
+        sec = (t2 - t1) / steps
+    return state, sec
+
+
+def _device_ms_per_step(profile_dir: str) -> float | None:
+    """Mean on-device ms per train step from the profiler's chrome trace
+    (the dominant 'XLA Modules' lane entry). Ground truth independent of
+    host-side sync semantics — logged next to the wall-clock number so a
+    tunnel-timing regression is visible immediately."""
+    import glob
+    import gzip
+    from collections import Counter
+
+    paths = glob.glob(f"{profile_dir}/plugins/profile/*/*.trace.json.gz")
+    if not paths:
+        return None
+    with gzip.open(max(paths), "rt") as f:
+        tr = json.load(f)
+    ev = tr.get("traceEvents", [])
+    lanes = {
+        (e["pid"], e["tid"]): e["args"]["name"]
+        for e in ev
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    tot, cnt = Counter(), Counter()
+    for e in ev:
+        if e.get("ph") == "X" and lanes.get((e["pid"], e["tid"])) == "XLA Modules":
+            tot[e["name"]] += e.get("dur", 0)
+            cnt[e["name"]] += 1
+    if not tot:
+        return None
+    name, dur = tot.most_common(1)[0]
+    return dur / 1e3 / cnt[name]  # µs -> ms, per execution
 
 
 def _param_count(params) -> int:
@@ -77,7 +144,8 @@ def bench_resnet(args) -> dict:
     log(f"devices: {n} x {devices[0].device_kind}")
     mesh = create_mesh(dp=-1, devices=devices)
 
-    model = resnet_lib.resnet(args.depth)
+    s2d = not args.no_s2d and args.image_size % 2 == 0
+    model = resnet_lib.resnet(args.depth, space_to_depth=s2d)
     rng = jax.random.PRNGKey(0)
     params, batch_stats = resnet_lib.create_train_state(
         model, rng, image_size=args.image_size
@@ -114,13 +182,18 @@ def bench_resnet(args) -> dict:
     warmup = max(args.warmup, 1)  # >=1: compile outside the timed window
     with mesh:
         if args.profile_dir:
-            # Warm/compile fully BEFORE the trace so it holds exactly
-            # args.steps steady-state steps, matching the reported timing.
+            # Warm/compile fully BEFORE the trace so it holds only
+            # steady-state steps (the two timed windows: steps//4 + steps
+            # executions; _device_ms_per_step divides by the traced count).
             state, _ = _timed_steps(fn, state, (images, labels), 0, warmup)
             jax.profiler.start_trace(args.profile_dir)
             state, sec = _timed_steps(fn, state, (images, labels), args.steps, 0)
             jax.profiler.stop_trace()
             log(f"profile written to {args.profile_dir}")
+            dev_ms = _device_ms_per_step(args.profile_dir)
+            if dev_ms:
+                log(f"device time from trace: {dev_ms:.1f} ms/step "
+                    f"(wall-clock diff-quotient: {sec * 1e3:.1f})")
         else:
             state, sec = _timed_steps(
                 fn, state, (images, labels), args.steps, warmup
@@ -367,6 +440,9 @@ def main() -> int:
                         help="sequence length (default: 512 bert, 2048 llama)")
     parser.add_argument("--bert-batch", type=int, default=64)
     parser.add_argument("--llama-batch", type=int, default=8)
+    parser.add_argument("--no-s2d", action="store_true",
+                        help="disable the space-to-depth ResNet stem "
+                             "(the MLPerf TPU transform; on by default)")
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument("--profile-dir", default="")

@@ -90,6 +90,14 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    # TPU stem: the 7x7/s2 conv on a 3-channel input underfeeds the MXU
+    # (contraction depth 7*3=21 of 128 lanes). space_to_depth regroups the
+    # input into 2x2 pixel blocks ([N,H,W,3] -> [N,H/2,W/2,12]) so the
+    # equivalent stride-1 4x4 conv contracts over 4*12=48 — the standard
+    # MLPerf ResNet TPU transform. Same function class: any 7x7/s2 stem
+    # kernel maps exactly onto the 4x4 layout (see s2d_stem_kernel);
+    # training from scratch just initializes the 4x4 form directly.
+    space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -107,8 +115,23 @@ class ResNet(nn.Module):
         block = BottleneckBlock if self.bottleneck else BasicBlock
 
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-                 name="conv_init")(x)
+        if self.space_to_depth:
+            b, h, w, c = x.shape
+            if h % 2 or w % 2:
+                raise ValueError(
+                    f"space_to_depth stem needs even spatial dims, got "
+                    f"{h}x{w}; pad the input or use space_to_depth=False"
+                )
+            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+            # pad (2,1): the 4x4 kernel is the 7x7 embedded in 8x8 with a
+            # leading zero row/col, i.e. taps at original offsets -4..+3
+            # around each output's 2x2 block -> 2 blocks left, 1 right.
+            x = conv(self.num_filters, (4, 4), strides=(1, 1),
+                     padding=[(2, 1), (2, 1)], name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), strides=(2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
@@ -128,12 +151,37 @@ class ResNet(nn.Module):
         return x.astype(jnp.float32)
 
 
-def resnet(depth: int, num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+def resnet(
+    depth: int,
+    num_classes: int = 1000,
+    dtype=jnp.bfloat16,
+    space_to_depth: bool = False,
+) -> ResNet:
     return ResNet(
         stage_sizes=STAGE_SIZES[depth],
         bottleneck=BOTTLENECK[depth],
         num_classes=num_classes,
         dtype=dtype,
+        space_to_depth=space_to_depth,
+    )
+
+
+def s2d_stem_kernel(k7):
+    """Map a [7, 7, C, O] stem kernel onto the space_to_depth [4, 4, 4C, O]
+    layout, exactly: embed into 8x8 with a leading zero row/col (the
+    kernel tap at original offset -4, which the 7x7 never reads), then
+    regroup rows/cols into (tap, subpixel) pairs matching the s2d input
+    channel order (dy, dx, c)."""
+    import numpy as np
+
+    k7 = np.asarray(k7)
+    c, o = k7.shape[2], k7.shape[3]
+    k8 = np.zeros((8, 8, c, o), k7.dtype)
+    k8[1:, 1:] = k7
+    return (
+        k8.reshape(4, 2, 4, 2, c, o)
+        .transpose(0, 2, 1, 3, 4, 5)
+        .reshape(4, 4, 4 * c, o)
     )
 
 
@@ -142,9 +190,11 @@ resnet101 = partial(resnet, 101)
 
 
 def flops_per_image(depth: int, image_size: int = 224) -> float:
-    """Approximate fwd FLOPs/image (for MFU accounting). Standard figures:
-    ResNet-50 ~4.1e9, ResNet-101 ~7.8e9 at 224x224; scale quadratically."""
-    base = {18: 1.8e9, 34: 3.7e9, 50: 4.1e9, 101: 7.8e9, 152: 11.5e9}[depth]
+    """Forward FLOPs/image at 224x224 in the 2xMAC convention (the one
+    TPU peak-TFLOP specs use, so TFLOP/s / peak = honest MFU). The
+    commonly quoted "ResNet-101 = 7.8 GFLOPs" is GMACs; x2 gives these
+    (torchvision/ptflops figures). Scales quadratically in resolution."""
+    base = {18: 3.6e9, 34: 7.3e9, 50: 8.2e9, 101: 15.7e9, 152: 23.1e9}[depth]
     return base * (image_size / 224) ** 2
 
 

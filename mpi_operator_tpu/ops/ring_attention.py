@@ -218,6 +218,21 @@ def ring_spec(mesh, axis: str = SP, n_heads: Optional[int] = None):
     return P(batch_axes if batch_axes else None, head_axis, axis, None)
 
 
+def sp_attention_specs(mesh, q_heads: int, kv_heads: int, axis: str = SP):
+    """(q_spec, kv_spec) for the [B, H, S, D] operands of either
+    sequence-parallel strategy (ring or Ulysses) — the single source of
+    truth that keeps the two layout-compatible. Heads ride tp only when
+    BOTH head counts divide the tp size; otherwise they stay replicated
+    and tp groups redo the attention."""
+    tp_ok = (
+        ring_spec(mesh, axis, q_heads)[1] == TP
+        and ring_spec(mesh, axis, kv_heads)[1] == TP
+    )
+    q_spec = ring_spec(mesh, axis, q_heads if tp_ok else None)
+    kv_spec = ring_spec(mesh, axis, kv_heads if tp_ok else None)
+    return q_spec, kv_spec
+
+
 def ring_attention_shard_mapped(
     q, k, v,
     mesh,
@@ -236,14 +251,7 @@ def ring_attention_shard_mapped(
     of all-gathering q/k/v and redoing the full attention tp times)."""
     from jax import shard_map
 
-    hq, hkv = q.shape[1], k.shape[1]
-    tp_heads = (
-        hq if (ring_spec(mesh, axis, hq)[1] == TP
-               and ring_spec(mesh, axis, hkv)[1] == TP)
-        else None
-    )
-    q_spec = ring_spec(mesh, axis, tp_heads)
-    kv_spec = ring_spec(mesh, axis, hkv if tp_heads else None)
+    q_spec, kv_spec = sp_attention_specs(mesh, q.shape[1], k.shape[1], axis)
     fn = shard_map(
         lambda a, b, c: ring_attention(
             a, b, c, axis, causal=causal, sm_scale=sm_scale,

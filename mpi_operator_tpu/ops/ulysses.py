@@ -42,7 +42,7 @@ import jax.numpy as jnp
 
 from ..parallel.mesh import SP
 from .attention import attention_reference, flash_attention
-from .ring_attention import ring_spec
+from .ring_attention import ring_spec, sp_attention_specs
 
 
 def _replicate_kv_for(h_kv: int, n: int):
@@ -134,14 +134,7 @@ def ulysses_attention_shard_mapped(
     """
     from jax import shard_map
 
-    hq, hkv = q.shape[1], k.shape[1]
-    tp_heads = (
-        hq if (ring_spec(mesh, axis, hq)[1] is not None
-               and ring_spec(mesh, axis, hkv)[1] is not None)
-        else None
-    )
-    q_spec = ring_spec(mesh, axis, tp_heads)
-    kv_spec = ring_spec(mesh, axis, hkv if tp_heads else None)
+    q_spec, kv_spec = sp_attention_specs(mesh, q.shape[1], k.shape[1], axis)
     fn = shard_map(
         lambda a, b, c: ulysses_attention(
             a, b, c, axis, causal=causal, sm_scale=sm_scale, impl=impl

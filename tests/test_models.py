@@ -57,6 +57,60 @@ class TestResNet:
         assert float(loss) < first_loss  # overfits a fixed batch
 
 
+class TestSpaceToDepthStem:
+    def test_exact_stem_equivalence(self, tiny_resnet):
+        """The s2d model with the transformed 7x7 kernel computes exactly
+        the plain model's function (the MLPerf TPU stem transform)."""
+        model, params, batch_stats = tiny_resnet
+        m_s2d = resnet_lib.resnet(
+            18, num_classes=16, dtype=jnp.float32, space_to_depth=True
+        )
+        x = jnp.asarray(
+            np.random.RandomState(0).standard_normal((2, 32, 32, 3)),
+            jnp.float32,
+        )
+        p = dict(params)
+        p["conv_init"] = {
+            "kernel": jnp.asarray(
+                resnet_lib.s2d_stem_kernel(params["conv_init"]["kernel"])
+            )
+        }
+        y_plain = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=False
+        )
+        y_s2d = m_s2d.apply(
+            {"params": p, "batch_stats": batch_stats}, x, train=False
+        )
+        np.testing.assert_allclose(y_plain, y_s2d, atol=1e-5, rtol=1e-5)
+
+    def test_s2d_param_shape(self):
+        m = resnet_lib.resnet(18, num_classes=8, space_to_depth=True)
+        params, _ = resnet_lib.create_train_state(
+            m, jax.random.PRNGKey(0), image_size=32, batch=1
+        )
+        assert params["conv_init"]["kernel"].shape == (4, 4, 12, 64)
+
+    def test_s2d_trains(self):
+        m = resnet_lib.resnet(18, num_classes=8, dtype=jnp.float32,
+                              space_to_depth=True)
+        params, stats = resnet_lib.create_train_state(
+            m, jax.random.PRNGKey(0), image_size=32, batch=4
+        )
+        opt = optax.sgd(0.1)
+        step = jax.jit(resnet_lib.make_train_step(m, opt))
+        images = jnp.asarray(
+            np.random.RandomState(0).standard_normal((4, 32, 32, 3)),
+            jnp.float32,
+        )
+        labels = jnp.asarray([0, 1, 2, 3])
+        params, stats, opt_state, l0 = step(params, stats, opt.init(params),
+                                            images, labels)
+        for _ in range(5):
+            params, stats, opt_state, loss = step(params, stats, opt_state,
+                                                  images, labels)
+        assert float(loss) < float(l0)
+
+
 class TestGraftEntry:
     def test_dryrun_multichip(self):
         import __graft_entry__
