@@ -89,7 +89,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "(ppermute k/v ring, O(S/n) activation residency, "
                         "any sp size) or 'ulysses' (two all-to-alls + "
                         "head-sharded flash; sp must divide the head count)")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="accumulate gradients over N sequential "
+                        "microbatches per optimizer step (LM models; "
+                        "--global-batch is the total across all N)")
+    p.add_argument("--lr-schedule", choices=["constant", "cosine"],
+                   default="constant",
+                   help="cosine: linear warmup over --warmup-steps then "
+                        "cosine decay to 0 at --steps")
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help="linear LR warmup steps (cosine schedule)")
     return p
+
+
+def _make_learning_rate(args):
+    """Scalar LR or an optax schedule, from --lr-schedule."""
+    if args.lr_schedule == "constant":
+        return args.lr
+    import optax
+
+    # warmup_steps=0 is valid (optax jumps straight to peak_value).
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=args.lr,
+        warmup_steps=args.warmup_steps,
+        decay_steps=max(args.steps, args.warmup_steps + 1),
+    )
 
 
 class Workload:
@@ -118,13 +143,18 @@ def _resnet_workload(args, mesh, n_devices: int) -> Workload:
     from ..models import resnet as resnet_lib
     from ..parallel import shard_batch, shard_params
 
+    if args.grad_accum > 1:
+        raise SystemExit(
+            "--grad-accum applies to LM models only (BatchNorm statistics "
+            "make microbatched ResNet steps non-equivalent)"
+        )
     depth = int(args.model.removeprefix("resnet"))
     global_batch = args.global_batch or 64 * n_devices
     model = resnet_lib.resnet(depth)
     params, batch_stats = resnet_lib.create_train_state(
         model, jax.random.PRNGKey(args.seed), image_size=args.image_size
     )
-    optimizer = optax.sgd(args.lr, momentum=0.9, nesterov=True)
+    optimizer = optax.sgd(_make_learning_rate(args), momentum=0.9, nesterov=True)
     opt_state = optimizer.init(params)
     params = shard_params(params, mesh)
     batch_stats = shard_params(batch_stats, mesh)
@@ -172,9 +202,22 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     sp = sizes.get(SP, 1)
     global_batch = args.global_batch or 8 * max(n_devices // sp, 1)
+    batch_shards = sizes.get("dp", 1) * sizes.get("fsdp", 1)
+    if args.grad_accum > 1:
+        if global_batch % args.grad_accum:
+            raise SystemExit(
+                f"--global-batch {global_batch} not divisible by "
+                f"--grad-accum {args.grad_accum}"
+            )
+        micro = global_batch // args.grad_accum
+        if micro % batch_shards:
+            raise SystemExit(
+                f"microbatch {micro} (= {global_batch}/{args.grad_accum}) "
+                f"not divisible by the dp*fsdp shard count {batch_shards}"
+            )
     rng = np.random.RandomState(args.seed)
 
-    optimizer = optax.adamw(args.lr)
+    optimizer = optax.adamw(_make_learning_rate(args))
     if args.model.startswith("bert"):
         from ..models import bert as lib
 
@@ -211,9 +254,12 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
             cfg = lib.tiny(attention_impl=attention, zigzag_ring=zigzag)
         model = lib.Llama(cfg, mesh=mesh)
         with mesh:
+            # Init shapes must themselves satisfy the mesh: ring/ulysses
+            # trace a shard_map at init, so the dummy batch has to split
+            # over dp*fsdp and the dummy seq over sp.
             params = lib.init_params(
                 model, jax.random.PRNGKey(args.seed),
-                batch=2, seq=max(16, sp * 16),
+                batch=max(2, batch_shards), seq=max(16, sp * 16),
             )
         tokens = shard_batch(
             jnp.asarray(
@@ -228,7 +274,10 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
     rules = lib.param_sharding_rules(mesh)
     params = shard_params(params, mesh, rules=rules)
     opt_state = shard_params(optimizer.init(params), mesh, rules=rules)
-    raw_step = jax.jit(lib.make_train_step(model, optimizer), donate_argnums=(0, 1))
+    raw_step = jax.jit(
+        lib.make_train_step(model, optimizer, accum_steps=args.grad_accum),
+        donate_argnums=(0, 1),
+    )
 
     def step_fn(state, batch):
         params, opt_state, loss = raw_step(

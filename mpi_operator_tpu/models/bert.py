@@ -131,7 +131,20 @@ def mlm_loss(model: Bert, params, tokens, mlm_positions_mask, mlm_targets):
     return jnp.sum(ce * weight) / jnp.maximum(jnp.sum(weight), 1.0)
 
 
-def make_train_step(model: Bert, optimizer):
+def make_train_step(model: Bert, optimizer, accum_steps: int = 1):
+    """``accum_steps > 1``: average gradients over that many sequential
+    microbatches (split on the batch dim) before the single optimizer
+    update — see ``parallel.accum``. (MLM's per-microbatch masked-token
+    weighting makes this the mean of weighted means, the standard
+    approximation when mask counts vary across microbatches.)"""
+    if accum_steps > 1:
+        from ..parallel.accum import make_accum_train_step
+
+        return make_accum_train_step(
+            lambda p, t, m, tg: mlm_loss(model, p, t, m, tg),
+            optimizer, accum_steps,
+        )
+
     def train_step(params, opt_state, tokens, mask, targets):
         loss, grads = jax.value_and_grad(
             lambda p: mlm_loss(model, p, tokens, mask, targets)

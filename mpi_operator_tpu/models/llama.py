@@ -316,7 +316,17 @@ def loss_fn(model: Llama, params, tokens):
     return jnp.mean(ce) + model.config.router_aux_coef * aux
 
 
-def make_train_step(model: Llama, optimizer):
+def make_train_step(model: Llama, optimizer, accum_steps: int = 1):
+    """``accum_steps > 1``: average gradients over that many sequential
+    microbatches (split on the batch dim) before the single optimizer
+    update — see ``parallel.accum``."""
+    if accum_steps > 1:
+        from ..parallel.accum import make_accum_train_step
+
+        return make_accum_train_step(
+            lambda p, toks: loss_fn(model, p, toks), optimizer, accum_steps
+        )
+
     def train_step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(
             lambda p: loss_fn(model, p, tokens)

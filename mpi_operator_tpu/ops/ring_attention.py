@@ -222,7 +222,7 @@ def sp_attention_specs(mesh, q_heads: int, kv_heads: int, axis: str = SP):
     """(q_spec, kv_spec) for the [B, H, S, D] operands of either
     sequence-parallel strategy (ring or Ulysses) — the single source of
     truth that keeps the two layout-compatible. Heads ride tp only when
-    BOTH head counts divide the tp size; otherwise they stay replicated
+    the tp size divides BOTH head counts; otherwise they stay replicated
     and tp groups redo the attention."""
     tp_ok = (
         ring_spec(mesh, axis, q_heads)[1] == TP

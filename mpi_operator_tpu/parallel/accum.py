@@ -1,0 +1,63 @@
+"""Gradient accumulation: one optimizer step from A sequential
+microbatches — effective batches beyond HBM capacity without changing
+training semantics.
+
+The reference operator delegates batching entirely to user programs
+(Horovod's gradient aggregation; SURVEY.md §2.4); here it is a framework
+primitive built the TPU way: a ``lax.scan`` over the leading
+accumulation axis inside ONE jitted step, so XLA keeps params resident
+in HBM across microbatches, the accumulator buffers are donated, and
+GSPMD shardings apply to each microbatch exactly as they would to a full
+batch (the dp allreduce happens once, on the averaged grads, not per
+microbatch).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def make_accum_train_step(loss_of_params, optimizer, accum_steps: int):
+    """Build ``step(params, opt_state, *batch) -> (params, opt_state,
+    loss)`` that averages gradients over ``accum_steps`` microbatches.
+
+    ``loss_of_params(params, *microbatch) -> scalar``. Every batch array
+    must have a leading dim divisible by ``accum_steps``; it is reshaped
+    to [A, b/A, ...] and scanned. The reported loss is the mean of the
+    microbatch losses — identical to the full-batch loss when the loss
+    is a mean over examples and microbatches are equal-sized (they are,
+    by construction).
+    """
+    if accum_steps < 2:
+        raise ValueError(f"accum_steps must be >= 2, got {accum_steps}")
+
+    def train_step(params, opt_state, *batch):
+        for x in batch:
+            if x.shape[0] % accum_steps:
+                raise ValueError(
+                    f"batch dim {x.shape[0]} not divisible by "
+                    f"accum_steps={accum_steps}"
+                )
+        mbs = tuple(
+            x.reshape(accum_steps, x.shape[0] // accum_steps, *x.shape[1:])
+            for x in batch
+        )
+
+        def micro(carry, mb):
+            gsum, lsum = carry
+            loss, grads = jax.value_and_grad(loss_of_params)(params, *mb)
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+            return (gsum, lsum + loss), None
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (gsum, lsum), _ = jax.lax.scan(
+            micro, (zeros, jnp.zeros((), jnp.float32)), mbs
+        )
+        grads = jax.tree_util.tree_map(lambda g: g / accum_steps, gsum)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_opt_state, lsum / accum_steps
+
+    return train_step

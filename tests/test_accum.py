@@ -1,0 +1,112 @@
+"""Gradient accumulation: N microbatches + one update == the full-batch
+step, on a single device and under dp sharding on the CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from mpi_operator_tpu.models import llama as llama_lib
+from mpi_operator_tpu.parallel import (
+    create_mesh,
+    make_accum_train_step,
+    shard_batch,
+    shard_params,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    cfg = llama_lib.tiny()
+    model = llama_lib.Llama(cfg)
+    params = llama_lib.init_params(model, jax.random.PRNGKey(0), batch=2, seq=16)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16)), jnp.int32
+    )
+    return model, params, tokens
+
+
+class TestAccumEquivalence:
+    def test_matches_full_batch_step(self, tiny_setup):
+        """SGD: mean-of-microbatch-grads == full-batch grad exactly (the
+        loss is a mean over equal-sized microbatches), so one accum step
+        must land on the same params."""
+        model, params, tokens = tiny_setup
+        opt = optax.sgd(1e-2)
+        full = jax.jit(llama_lib.make_train_step(model, opt))
+        accum = jax.jit(llama_lib.make_train_step(model, opt, accum_steps=4))
+        p1, _, l1 = full(params, opt.init(params), tokens)
+        p2, _, l2 = accum(params, opt.init(params), tokens)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(p1),
+                        jax.tree_util.tree_leaves(p2)):
+            np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-5)
+
+    def test_rejects_indivisible_batch(self, tiny_setup):
+        model, params, tokens = tiny_setup
+        opt = optax.sgd(1e-2)
+        step = llama_lib.make_train_step(model, opt, accum_steps=3)
+        with pytest.raises(ValueError, match="not divisible"):
+            step(params, opt.init(params), tokens)  # 8 % 3 != 0
+
+    def test_rejects_accum_below_two(self):
+        with pytest.raises(ValueError, match="accum_steps"):
+            make_accum_train_step(lambda p: 0.0, optax.sgd(0.1), 1)
+
+    def test_under_dp_sharding(self, tiny_setup):
+        """Accum step compiles and runs with the batch sharded over dp
+        (each microbatch re-shards to [G/A over dp])."""
+        model_ref, params, tokens = tiny_setup
+        mesh = create_mesh(dp=8)
+        model = llama_lib.Llama(model_ref.config, mesh=mesh)
+        opt = optax.sgd(1e-2)
+        params_s = shard_params(params, mesh)
+        toks = shard_batch(
+            jnp.concatenate([tokens, tokens], 0), mesh  # batch 16 over dp=8
+        )
+        step = jax.jit(llama_lib.make_train_step(model, opt, accum_steps=2))
+        with mesh:
+            p, _, loss = step(params_s, opt.init(params_s), toks)
+        assert jnp.isfinite(loss)
+
+
+class TestTrainerFlags:
+    def test_grad_accum_cli(self, capsys):
+        from tests.test_train import run_train
+
+        m = run_train(
+            capsys, "--model", "llama-tiny", "--steps", "3", "--warmup", "1",
+            "--grad-accum", "2", "--global-batch", "16", "--seq-len", "16",
+            "--log-every", "0",
+        )
+        assert m["final_step"] == 3
+
+    def test_microbatch_shard_mismatch_rejected(self):
+        # global 8 / accum 2 = microbatch 4, not divisible by dp=8.
+        from mpi_operator_tpu.cmd import train as train_cmd
+
+        with pytest.raises(SystemExit, match="dp\\*fsdp"):
+            train_cmd.main([
+                "--model", "llama-tiny", "--steps", "1", "--grad-accum", "2",
+                "--global-batch", "8", "--seq-len", "16",
+            ])
+
+    def test_grad_accum_rejected_for_resnet(self):
+        from mpi_operator_tpu.cmd import train as train_cmd
+
+        with pytest.raises(SystemExit):
+            train_cmd.main([
+                "--model", "resnet18", "--steps", "1", "--grad-accum", "2",
+                "--global-batch", "8", "--image-size", "32",
+            ])
+
+    def test_cosine_schedule_cli(self, capsys):
+        from tests.test_train import run_train
+
+        m = run_train(
+            capsys, "--model", "bert-tiny", "--steps", "4", "--warmup", "1",
+            "--lr-schedule", "cosine", "--warmup-steps", "2",
+            "--global-batch", "8", "--seq-len", "16", "--log-every", "0",
+        )
+        assert m["final_step"] == 4
