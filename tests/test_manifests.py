@@ -143,6 +143,64 @@ def test_examples_validate_against_crd_schema(path):
         assert not errs, f"{path}: {errs}"
 
 
+@pytest.mark.parametrize("path", example_files(), ids=lambda p: p.stem)
+def test_examples_pass_full_admission(path):
+    """Every shipped example must survive defaulting + semantic
+    validation (replica counts vs slice topology, restart policies) —
+    the CRD-schema test above cannot catch those (a v5p-64 job with the
+    wrong worker count is schema-valid but unschedulable)."""
+    from mpi_operator_tpu.api.v2beta1.defaults import set_defaults_tpujob
+    from mpi_operator_tpu.api.v2beta1.types import TPUJob
+    from mpi_operator_tpu.api.validation import validate_tpujob
+
+    for doc in load_all(path):
+        if doc.get("kind") != "TPUJob":
+            continue
+        job = TPUJob.from_dict(doc)
+        set_defaults_tpujob(job)
+        errs = validate_tpujob(job)
+        assert not errs, f"{path}: {errs}"
+
+
+@pytest.mark.parametrize("path", example_files(), ids=lambda p: p.stem)
+def test_examples_mesh_spec_matches_slice(path):
+    """Examples that launch cmd.train with --mesh must size the mesh to
+    the slice: the axis product times numSlices' division must equal the
+    job's total chip count (admission cannot check this — the operator
+    does not interpret user commands — but OUR examples use OUR trainer,
+    so the repo can hold them coherent)."""
+    from mpi_operator_tpu.api import topology as topo
+    from mpi_operator_tpu.cmd.train import parse_mesh_spec
+
+    for doc in load_all(path):
+        if doc.get("kind") != "TPUJob":
+            continue
+        spec = doc["spec"]
+        accel = spec.get("tpu", {}).get("acceleratorType")
+        if not accel:
+            continue
+        shape = topo.resolve(accel, spec["tpu"].get("topology") or "")
+        chips = shape.chips * spec["tpu"].get("numSlices", 1)
+        for container in (
+            spec["tpuReplicaSpecs"]["Worker"]["template"]["spec"]["containers"]
+        ):
+            mesh_args = [
+                a for a in (container.get("command") or [])
+                if a.startswith("--mesh=")
+            ]
+            for arg in mesh_args:
+                axes = parse_mesh_spec(arg.removeprefix("--mesh="))
+                if -1 in axes.values():
+                    continue  # auto-sized axis adapts to any chip count
+                product = 1
+                for v in axes.values():
+                    product *= v
+                assert product == chips, (
+                    f"{path}: mesh {arg} = {product} devices but "
+                    f"{accel} x{spec['tpu'].get('numSlices', 1)} has {chips}"
+                )
+
+
 def test_crd_schema_rejects_bad_specs():
     schema = crd_doc()["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
     bad = {
