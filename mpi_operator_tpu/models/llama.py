@@ -45,6 +45,13 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # What the per-layer checkpoint saves: 'full' recomputes everything
+    # in the backward pass (min HBM, +~33% FLOPs); 'dots' saves matmul
+    # outputs and recomputes only cheap elementwise ops
+    # (jax.checkpoint_policies.dots_with_no_batch_dims_saveable — the
+    # standard TPU transformer policy: the MXU never re-runs, HBM still
+    # drops the big attention/FFN intermediates).
+    remat_policy: str = "full"
     tie_embeddings: bool = False
     # 'flash' (pallas kernel), 'dense' (XLA reference), 'ring'
     # (sequence-parallel ppermute ring over the sp mesh axis), or
@@ -70,6 +77,11 @@ class LlamaConfig:
     moe_top_k: int = 2
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
+    # > 0: the train loss computes cross-entropy in sequence chunks of
+    # this size (ops/losses.py:lm_xent_chunked) instead of materializing
+    # the full [B, S, V] f32 logits — peak logits memory drops to
+    # O(chunk * V) in both passes. 0 = standard full-logits path.
+    xent_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -240,7 +252,11 @@ class Llama(nn.Module):
     mesh: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden: bool = False):
+        """``return_hidden=True`` skips the LM head and returns
+        ``(hidden, aux)`` — the chunked-loss path applies the head
+        incrementally (ops/losses.py) so full logits never materialize.
+        """
         cfg = self.config
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1]), tokens.shape
@@ -268,7 +284,16 @@ class Llama(nn.Module):
             positions = jnp.broadcast_to(perm, tokens.shape)
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, static_argnums=())
+            if cfg.remat_policy == "full":
+                policy = None
+            elif cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            else:
+                raise ValueError(
+                    f"remat_policy must be 'full' or 'dots', got "
+                    f"{cfg.remat_policy!r}"
+                )
+            block = nn.remat(Block, static_argnums=(), policy=policy)
         aux_total = jnp.float32(0.0)
         for i in range(cfg.n_layers):
             h, aux = block(cfg, self.mesh, name=f"layer_{i}")(h, positions)
@@ -276,6 +301,8 @@ class Llama(nn.Module):
         h = RMSNorm(cfg.norm_eps, name="final_norm")(h)
         if unperm is not None:
             h = h[:, unperm]  # back to natural order for the LM head/loss
+        if return_hidden:
+            return h, aux_total
         # Untied lm_head (Llama-3 does not tie embeddings); f32 logits for
         # a stable softmax-CE.
         if cfg.tie_embeddings:
@@ -304,16 +331,33 @@ def init_params(model: Llama, rng, batch: int = 2, seq: int = 16):
 def loss_fn(model: Llama, params, tokens):
     """Next-token cross-entropy (+ router aux loss for MoE configs). The
     full sequence goes through the model (keeping the length divisible by
-    the sp axis for ring attention); the shift happens on the logits."""
+    the sp axis for ring attention); the shift happens on the logits.
+
+    With ``cfg.xent_chunk > 0`` the head + CE run chunked
+    (ops/losses.py:lm_xent_chunked): same masked mean, but the [B, S, V]
+    f32 logits never materialize."""
+    cfg = model.config
+    if cfg.xent_chunk > 0:
+        from ..ops.losses import lm_xent_chunked
+
+        h, aux = model.apply({"params": params}, tokens, return_hidden=True)
+        if cfg.tie_embeddings:
+            w = params["embed"]["embedding"].T
+        else:
+            w = params["lm_head"]["kernel"]
+        ce = lm_xent_chunked(
+            h[:, :-1], w, tokens[:, 1:], chunk=cfg.xent_chunk
+        )
+        return ce + cfg.router_aux_coef * (aux if cfg.is_moe else 0.0)
     out = model.apply({"params": params}, tokens)
-    if model.config.is_moe:
+    if cfg.is_moe:
         logits, aux = out
     else:
         logits, aux = out, 0.0
     ce = optax.softmax_cross_entropy_with_integer_labels(
         logits[:, :-1], tokens[:, 1:]
     )
-    return jnp.mean(ce) + model.config.router_aux_coef * aux
+    return jnp.mean(ce) + cfg.router_aux_coef * aux
 
 
 def make_train_step(model: Llama, optimizer, accum_steps: int = 1):

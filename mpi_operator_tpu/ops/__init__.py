@@ -16,12 +16,14 @@ from .ring_attention import (
     zigzag_indices,
     zigzag_inverse,
 )
+from .losses import lm_xent_chunked
 from .ulysses import ulysses_attention, ulysses_attention_sharded
 
 __all__ = [
     "attention_reference",
     "flash_attention",
     "flash_attention_lse",
+    "lm_xent_chunked",
     "ring_attention",
     "ring_attention_sharded",
     "ulysses_attention",

@@ -1,0 +1,69 @@
+"""Memory-lean LM losses.
+
+The standard next-token loss materializes f32 logits of shape
+[B, S, V] — for Llama-class vocabularies that single tensor dwarfs every
+activation in the network (B=8, S=2048, V=128k -> 8 GB f32) and its
+HBM round-trips dominate the loss+head cost. ``lm_xent_chunked``
+computes the same cross-entropy in sequence chunks inside a
+``lax.scan``, wrapping each chunk in ``jax.checkpoint`` so the backward
+pass recomputes the chunk's logits instead of saving them: peak logits
+residency drops from O(S·V) to O(chunk·V), forward and backward, with
+bit-identical-up-to-reassociation results.
+
+No reference analog (the reference orchestrates user containers and owns
+no math — SURVEY.md §2.4); this is framework-owned compute, the same
+category as the flash/ring attention kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def lm_xent_chunked(h, w, targets, weights=None, *, chunk: int = 512):
+    """Mean cross-entropy of ``softmax(h @ w)`` against ``targets``,
+    computed ``chunk`` sequence positions at a time.
+
+    h: [B, S, D] hidden states (any float dtype; logits are f32).
+    w: [D, V] head kernel (f32 recommended).
+    targets: [B, S] int labels.
+    weights: optional [B, S] float mask; defaults to all-ones. The
+    result is sum(ce * weights) / max(sum(weights), 1) — identical to
+    the unchunked masked mean.
+
+    S need not divide ``chunk``: the tail is padded with weight 0.
+    """
+    b, s, d = h.shape
+    chunk = max(1, min(chunk, s))
+    if weights is None:
+        weights = jnp.ones((b, s), jnp.float32)
+    weights = weights.astype(jnp.float32)
+
+    pad = (-s) % chunk
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        weights = jnp.pad(weights, ((0, 0), (0, pad)))
+    n = (s + pad) // chunk
+
+    # [n, B, chunk, ...] so the scan walks sequence chunks.
+    h_c = h.reshape(b, n, chunk, d).transpose(1, 0, 2, 3)
+    t_c = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+    w_c = weights.reshape(b, n, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_loss(hc, tc, wc):
+        logits = jnp.dot(
+            hc.astype(jnp.float32), w.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
+        return jnp.sum(ce * wc)
+
+    def body(acc, xs):
+        return acc + chunk_loss(*xs), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (h_c, t_c, w_c))
+    return total / jnp.maximum(jnp.sum(weights), 1.0)

@@ -162,6 +162,36 @@ class TestLlama:
         flat = jax.tree_util.tree_leaves(grads)
         assert all(bool(jnp.all(jnp.isfinite(g))) for g in flat)
 
+    def test_remat_policies_value_equivalent(self):
+        """Rematerialization must never change values: none/full/dots
+        produce identical loss and gradients ('dots' saves matmul outputs
+        so the MXU never re-runs in the backward pass)."""
+        tokens = _tokens(np.random.RandomState(0), 2, 32, 256)
+        results = {}
+        for name, kw in [("none", dict(remat=False)),
+                         ("full", dict(remat=True, remat_policy="full")),
+                         ("dots", dict(remat=True, remat_policy="dots"))]:
+            cfg = llama_lib.tiny(**kw)
+            model = llama_lib.Llama(cfg)
+            params = llama_lib.init_params(
+                model, jax.random.PRNGKey(0), batch=2, seq=32
+            )
+            loss, grads = jax.jit(jax.value_and_grad(
+                lambda p, m=model: llama_lib.loss_fn(m, p, tokens)
+            ))(params)
+            results[name] = (float(loss), grads)
+        for name in ("full", "dots"):
+            assert results[name][0] == pytest.approx(results["none"][0])
+            for a, b in zip(jax.tree_util.tree_leaves(results["none"][1]),
+                            jax.tree_util.tree_leaves(results[name][1])):
+                np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+    def test_remat_policy_rejects_unknown(self):
+        cfg = llama_lib.tiny(remat=True, remat_policy="bogus")
+        model = llama_lib.Llama(cfg)
+        with pytest.raises(ValueError, match="remat_policy"):
+            llama_lib.init_params(model, jax.random.PRNGKey(0))
+
     def test_full_size_config_matches_llama3_8b(self):
         cfg = llama_lib.llama3_8b()
         assert cfg.dim == 4096 and cfg.n_layers == 32
