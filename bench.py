@@ -248,27 +248,45 @@ def bench_bert(args) -> dict:
     batch = args.bert_batch * n  # global batch, sharded over dp
     rng = np.random.RandomState(0)
     tokens = shard_batch(rng.randint(0, cfg.vocab_size, (batch, seq_len)), mesh)
-    # 15% MLM positions, BERT pretraining convention.
-    mask = shard_batch(rng.uniform(size=(batch, seq_len)) < 0.15, mesh)
-    targets = shard_batch(rng.randint(0, cfg.vocab_size, (batch, seq_len)), mesh)
+    # Gathered-positions MLM batch (TF-BERT max_predictions_per_seq
+    # convention): the head computes logits at the 15% masked slots
+    # only, not all S positions.
+    n_pred = max(int(seq_len * 0.15), 1)
+    positions = shard_batch(
+        np.stack([
+            np.sort(rng.choice(seq_len, n_pred, replace=False))
+            for _ in range(batch)
+        ]).astype(np.int32),
+        mesh,
+    )
+    targets = shard_batch(rng.randint(0, cfg.vocab_size, (batch, n_pred)), mesh)
+    weights = shard_batch(np.ones((batch, n_pred), np.float32), mesh)
 
     step = jax.jit(
-        bert_lib.make_train_step(model, optimizer), donate_argnums=(0, 1)
+        bert_lib.make_train_step_positions(model, optimizer),
+        donate_argnums=(0, 1),
     )
     log(f"compiling bert-base train step (batch {batch} x seq {seq_len}, "
-        f"{n_params / 1e6:.0f}M params)...")
+        f"{n_pred} preds/seq, {n_params / 1e6:.0f}M params)...")
     with mesh:
         (_, _, loss), sec = _timed_steps(
-            lambda p, o, l_, t, m, tg: step(p, o, t, m, tg),
-            (params, opt_state, None), (tokens, mask, targets),
+            lambda p, o, l_, t, pos, tg, w: step(p, o, t, pos, tg, w),
+            (params, opt_state, None), (tokens, positions, targets, weights),
             args.steps, max(args.warmup, 1),
         )
 
     seqs_per_sec = batch / sec / n
-    # Train FLOPs/token ≈ 6·N_params + 12·L·d·s (full bidirectional
-    # attention; PaLM-appendix accounting, fwd+bwd = 3× fwd).
-    flops_tok = 6 * n_params + 12 * cfg.n_layers * cfg.dim * seq_len
-    tflops = flops_tok * batch * seq_len / sec / n / 1e12
+    # PaLM-appendix accounting (fwd+bwd = 3x fwd), head-aware: encoder
+    # params run on all S tokens, the MLM head (d*d transform + d*V
+    # tied decode) only on the n_pred gathered positions; bidirectional
+    # attention adds 12·L·d·s per token.
+    n_head = cfg.dim * cfg.vocab_size + cfg.dim * cfg.dim
+    flops_seq = (
+        (6 * (n_params - n_head) + 12 * cfg.n_layers * cfg.dim * seq_len)
+        * seq_len
+        + 6 * n_head * n_pred
+    )
+    tflops = flops_seq * batch / sec / n / 1e12
     log(
         f"bert-base: {seqs_per_sec:.1f} seq/s/chip, {sec * 1000:.1f} ms/step, "
         f"loss {float(loss):.3f}, ~{tflops:.1f} TFLOP/s/chip "

@@ -82,7 +82,13 @@ class Bert(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, tokens, token_types=None):
+    def __call__(self, tokens, token_types=None, mlm_positions=None):
+        """``mlm_positions`` ([B, P] int, optional): gather the encoder
+        output at just those positions before the MLM head, so the
+        transform + vocab decode run on P ≈ 0.15·S masked slots instead
+        of all S — the classic BERT-pretraining head optimization (the
+        head's vocab matmul is ~6.7x smaller at the standard 15% mask
+        rate). Returns [B, P, V] logits instead of [B, S, V]."""
         cfg = self.config
         b, s = tokens.shape
         embed = nn.Embed(
@@ -102,6 +108,10 @@ class Bert(nn.Module):
         h = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="embed_norm")(h)
         for i in range(cfg.n_layers):
             h = EncoderLayer(cfg, name=f"layer_{i}")(h)
+        if mlm_positions is not None:
+            h = jnp.take_along_axis(
+                h, mlm_positions[..., None].astype(jnp.int32), axis=1
+            )
         # MLM head: transform + tied decoder, f32 logits.
         h = nn.Dense(
             cfg.dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="mlm_dense"
@@ -124,11 +134,28 @@ def init_params(model: Bert, rng, batch: int = 2, seq: int = 16):
 
 def mlm_loss(model: Bert, params, tokens, mlm_positions_mask, mlm_targets):
     """Masked-LM cross-entropy; ``mlm_positions_mask`` is 1.0 where the
-    token was masked out (loss counted), 0.0 elsewhere."""
+    token was masked out (loss counted), 0.0 elsewhere. Computes the
+    full [B, S, V] logits — use :func:`mlm_loss_positions` for the
+    gathered-head variant (same value for matching masks)."""
     logits = model.apply({"params": params}, tokens)
     ce = optax.softmax_cross_entropy_with_integer_labels(logits, mlm_targets)
     weight = mlm_positions_mask.astype(jnp.float32)
     return jnp.sum(ce * weight) / jnp.maximum(jnp.sum(weight), 1.0)
+
+
+def mlm_loss_positions(model: Bert, params, tokens, mlm_positions,
+                       mlm_targets, mlm_weights):
+    """Masked-LM cross-entropy over gathered positions (the TF-BERT
+    ``max_predictions_per_seq`` interface): ``mlm_positions`` [B, P]
+    indexes the masked slots, ``mlm_targets`` [B, P] their original
+    tokens, ``mlm_weights`` [B, P] 1.0 for real predictions / 0.0 for
+    padding slots. The MLM head runs on P positions, not S."""
+    logits = model.apply(
+        {"params": params}, tokens, mlm_positions=mlm_positions
+    )
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, mlm_targets)
+    w = mlm_weights.astype(jnp.float32)
+    return jnp.sum(ce * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
 def make_train_step(model: Bert, optimizer, accum_steps: int = 1):
@@ -137,23 +164,24 @@ def make_train_step(model: Bert, optimizer, accum_steps: int = 1):
     update — see ``parallel.accum``. (MLM's per-microbatch masked-token
     weighting makes this the mean of weighted means, the standard
     approximation when mask counts vary across microbatches.)"""
-    if accum_steps > 1:
-        from ..parallel.accum import make_accum_train_step
+    from ..parallel.accum import make_update_step
 
-        return make_accum_train_step(
-            lambda p, t, m, tg: mlm_loss(model, p, t, m, tg),
-            optimizer, accum_steps,
-        )
+    return make_update_step(
+        lambda p, t, m, tg: mlm_loss(model, p, t, m, tg),
+        optimizer, accum_steps,
+    )
 
-    def train_step(params, opt_state, tokens, mask, targets):
-        loss, grads = jax.value_and_grad(
-            lambda p: mlm_loss(model, p, tokens, mask, targets)
-        )(params)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
 
-    return train_step
+def make_train_step_positions(model: Bert, optimizer, accum_steps: int = 1):
+    """Train step over the gathered-positions MLM batch layout
+    ``(tokens, mlm_positions, mlm_targets, mlm_weights)`` — the head
+    computes P-position logits only (see :func:`mlm_loss_positions`)."""
+    from ..parallel.accum import make_update_step
+
+    return make_update_step(
+        lambda p, t, pos, tg, w: mlm_loss_positions(model, p, t, pos, tg, w),
+        optimizer, accum_steps,
+    )
 
 
 def param_sharding_rules(mesh):

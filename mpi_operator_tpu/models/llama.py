@@ -364,22 +364,11 @@ def make_train_step(model: Llama, optimizer, accum_steps: int = 1):
     """``accum_steps > 1``: average gradients over that many sequential
     microbatches (split on the batch dim) before the single optimizer
     update — see ``parallel.accum``."""
-    if accum_steps > 1:
-        from ..parallel.accum import make_accum_train_step
+    from ..parallel.accum import make_update_step
 
-        return make_accum_train_step(
-            lambda p, toks: loss_fn(model, p, toks), optimizer, accum_steps
-        )
-
-    def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(model, p, tokens)
-        )(params)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
-    return train_step
+    return make_update_step(
+        lambda p, toks: loss_fn(model, p, toks), optimizer, accum_steps
+    )
 
 
 def param_sharding_rules(mesh):

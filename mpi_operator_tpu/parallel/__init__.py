@@ -9,7 +9,7 @@ specs for the example workloads: dp (data), pp (pipeline stages), fsdp
 sp (sequence/context).
 """
 
-from .accum import make_accum_train_step  # noqa: F401
+from .accum import make_accum_train_step, make_update_step  # noqa: F401
 from .mesh import MeshConfig, create_mesh, local_batch_size  # noqa: F401
 
 # Exported as run_pipeline: re-exporting the function under its module's

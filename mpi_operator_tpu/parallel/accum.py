@@ -19,6 +19,23 @@ import jax.numpy as jnp
 import optax
 
 
+def make_update_step(loss_of_params, optimizer, accum_steps: int = 1):
+    """The one train-step builder every model family shares:
+    ``loss_of_params(params, *batch) -> scalar`` becomes
+    ``step(params, opt_state, *batch) -> (params, opt_state, loss)``.
+    ``accum_steps > 1`` routes through :func:`make_accum_train_step`."""
+    if accum_steps > 1:
+        return make_accum_train_step(loss_of_params, optimizer, accum_steps)
+
+    def train_step(params, opt_state, *batch):
+        loss, grads = jax.value_and_grad(loss_of_params)(params, *batch)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_opt_state, loss
+
+    return train_step
+
+
 def make_accum_train_step(loss_of_params, optimizer, accum_steps: int):
     """Build ``step(params, opt_state, *batch) -> (params, opt_state,
     loss)`` that averages gradients over ``accum_steps`` microbatches.

@@ -230,6 +230,75 @@ class TestBert:
             first = float(loss) if first is None else first
         assert float(loss) < first * 0.8, (first, float(loss))
 
+    def test_positions_loss_matches_mask_loss(self):
+        """Gathered-positions MLM loss == full-logits masked loss when
+        the positions are exactly the masked slots."""
+        cfg = bert_lib.tiny()
+        model = bert_lib.Bert(cfg)
+        rng = np.random.RandomState(0)
+        tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 16)), jnp.int32)
+        targets_full = jnp.asarray(
+            rng.randint(0, cfg.vocab_size, (2, 16)), jnp.int32
+        )
+        params = bert_lib.init_params(model, jax.random.PRNGKey(0))
+        # 3 masked slots per example (distinct, sorted).
+        pos = jnp.asarray(
+            np.stack([np.sort(rng.choice(16, 3, replace=False))
+                      for _ in range(2)]).astype(np.int32)
+        )
+        mask = np.zeros((2, 16), np.float32)
+        for i in range(2):
+            mask[i, np.asarray(pos[i])] = 1.0
+        l_mask = bert_lib.mlm_loss(
+            model, params, tokens, jnp.asarray(mask), targets_full
+        )
+        l_pos = bert_lib.mlm_loss_positions(
+            model, params, tokens, pos,
+            jnp.take_along_axis(targets_full, pos, axis=1),
+            jnp.ones((2, 3), jnp.float32),
+        )
+        np.testing.assert_allclose(float(l_mask), float(l_pos), rtol=1e-5)
+
+    def test_positions_padding_slots_ignored(self):
+        """weight-0 padding slots do not change the loss."""
+        cfg = bert_lib.tiny()
+        model = bert_lib.Bert(cfg)
+        rng = np.random.RandomState(1)
+        tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 16)), jnp.int32)
+        params = bert_lib.init_params(model, jax.random.PRNGKey(0))
+        pos = jnp.asarray([[2, 5, 9]], jnp.int32)
+        tg = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 3)), jnp.int32)
+        l3 = bert_lib.mlm_loss_positions(
+            model, params, tokens, pos, tg, jnp.ones((1, 3), jnp.float32)
+        )
+        pos_p = jnp.asarray([[2, 5, 9, 0, 0]], jnp.int32)
+        tg_p = jnp.concatenate([tg, jnp.zeros((1, 2), jnp.int32)], 1)
+        w_p = jnp.asarray([[1, 1, 1, 0, 0]], jnp.float32)
+        l5 = bert_lib.mlm_loss_positions(
+            model, params, tokens, pos_p, tg_p, w_p
+        )
+        np.testing.assert_allclose(float(l3), float(l5), rtol=1e-6)
+
+    def test_positions_train_step_learns(self):
+        cfg = bert_lib.tiny()
+        model = bert_lib.Bert(cfg)
+        rng = np.random.RandomState(2)
+        tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 16)), jnp.int32)
+        pos = jnp.asarray(
+            np.stack([np.sort(rng.choice(16, 3, replace=False))
+                      for _ in range(4)]).astype(np.int32)
+        )
+        tg = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 3)), jnp.int32)
+        w = jnp.ones((4, 3), jnp.float32)
+        params = bert_lib.init_params(model, jax.random.PRNGKey(0))
+        opt = optax.adamw(1e-3)
+        step = jax.jit(bert_lib.make_train_step_positions(model, opt))
+        opt_state = opt.init(params)
+        params, opt_state, l0 = step(params, opt_state, tokens, pos, tg, w)
+        for _ in range(8):
+            params, opt_state, loss = step(params, opt_state, tokens, pos, tg, w)
+        assert float(loss) < float(l0)
+
     def test_token_types_change_output(self):
         cfg = bert_lib.tiny()
         model = bert_lib.Bert(cfg)
