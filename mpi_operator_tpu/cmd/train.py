@@ -89,6 +89,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(ppermute k/v ring, O(S/n) activation residency, "
                         "any sp size) or 'ulysses' (two all-to-alls + "
                         "head-sharded flash; sp must divide the head count)")
+    p.add_argument("--remat-policy", choices=["full", "dots"], default="full",
+                   help="per-layer checkpoint policy (llama): 'dots' saves "
+                        "matmul outputs so the MXU never re-runs backward")
+    p.add_argument("--xent-chunk", type=int, default=0,
+                   help="compute the LM head + cross-entropy this many "
+                        "sequence positions at a time (llama; 0 = full "
+                        "[B,S,V] logits)")
     p.add_argument("--grad-accum", type=int, default=1,
                    help="accumulate gradients over N sequential "
                         "microbatches per optimizer step (LM models; "
@@ -190,6 +197,29 @@ def _resnet_workload(args, mesh, n_devices: int) -> Workload:
     )
 
 
+def llama_config_from_args(args, sp: int):
+    """Build the LlamaConfig a CLI invocation asks for — separated from
+    the workload builder so flag→config threading is unit-testable
+    (every CLI-scale model has remat=False, which would otherwise leave
+    --remat-policy regressions invisible to e2e runs)."""
+    from ..models import llama as lib
+
+    attention = args.sequence_parallel if sp > 1 else "flash"
+    kw = dict(
+        attention_impl=attention,
+        zigzag_ring=bool(args.zigzag_ring and sp > 1 and attention == "ring"),
+        remat_policy=args.remat_policy,
+        xent_chunk=args.xent_chunk,
+    )
+    if args.model == "llama3-8b":
+        return lib.llama3_8b(**kw)
+    if args.model == "mixtral-8x7b":
+        return lib.mixtral_8x7b(**kw)
+    if args.model == "llama-moe-tiny":
+        return lib.tiny_moe(**kw)
+    return lib.tiny(**kw)
+
+
 def _lm_workload(args, mesh, n_devices: int) -> Workload:
     import jax
     import jax.numpy as jnp
@@ -240,18 +270,7 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
     else:
         from ..models import llama as lib
 
-        attention = args.sequence_parallel if sp > 1 else "flash"
-        zigzag = bool(
-            args.zigzag_ring and sp > 1 and attention == "ring"
-        )
-        if args.model == "llama3-8b":
-            cfg = lib.llama3_8b(attention_impl=attention, zigzag_ring=zigzag)
-        elif args.model == "mixtral-8x7b":
-            cfg = lib.mixtral_8x7b(attention_impl=attention, zigzag_ring=zigzag)
-        elif args.model == "llama-moe-tiny":
-            cfg = lib.tiny_moe(attention_impl=attention, zigzag_ring=zigzag)
-        else:
-            cfg = lib.tiny(attention_impl=attention, zigzag_ring=zigzag)
+        cfg = llama_config_from_args(args, sp)
         model = lib.Llama(cfg, mesh=mesh)
         with mesh:
             # Init shapes must themselves satisfy the mesh: ring/ulysses

@@ -59,6 +59,32 @@ class TestTrainModels:
         assert m["final_step"] == 3
         assert m["devices"] == 8
 
+    def test_llama_tiny_chunked_xent_and_remat_policy(self, capsys):
+        m = run_train(
+            capsys, "--model", "llama-tiny", "--steps", "3", "--warmup", "1",
+            "--xent-chunk", "8", "--remat-policy", "dots",
+            "--global-batch", "8", "--seq-len", "32", "--log-every", "0",
+        )
+        assert m["final_step"] == 3
+
+    def test_flags_thread_into_llama_config(self):
+        """Flag→config threading, unit-level: CLI-scale models run with
+        remat=False, so an e2e run cannot notice a dropped
+        --remat-policy; assert on the built config instead."""
+        for model, expect_remat in [("llama-tiny", False), ("llama3-8b", True)]:
+            args = train_cmd.build_parser().parse_args([
+                "--model", model, "--remat-policy", "dots",
+                "--xent-chunk", "128", "--sequence-parallel", "ulysses",
+            ])
+            cfg = train_cmd.llama_config_from_args(args, sp=2)
+            assert cfg.remat_policy == "dots"
+            assert cfg.xent_chunk == 128
+            assert cfg.attention_impl == "ulysses"
+            assert cfg.remat is expect_remat
+        # sp=1 forces plain flash regardless of --sequence-parallel.
+        cfg = train_cmd.llama_config_from_args(args, sp=1)
+        assert cfg.attention_impl == "flash"
+
     def test_llama_tiny_ulysses_sequence_parallel(self, capsys):
         m = run_train(
             capsys, "--model", "llama-tiny", "--steps", "3", "--warmup", "1",
