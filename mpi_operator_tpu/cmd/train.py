@@ -56,8 +56,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "llama3-8b|llama-tiny|mixtral-8x7b|llama-moe-tiny")
     p.add_argument("--mesh", default="",
                    help="axis spec, e.g. dp=2,fsdp=4,tp=2 (axes: dp fsdp "
-                        "ep tp sp; pp is the parallel.run_pipeline API and "
-                        "has no stock-workload wiring yet)")
+                        "ep tp sp pp; pp pipelines dense llama blocks via "
+                        "GPipe — see --pp-microbatch)")
     p.add_argument("--steps", type=int, default=100,
                    help="ABSOLUTE target step: a resumed run trains only the "
                         "remainder from the latest checkpoint")
@@ -100,6 +100,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="accumulate gradients over N sequential "
                         "microbatches per optimizer step (LM models; "
                         "--global-batch is the total across all N)")
+    p.add_argument("--pp-microbatch", type=int, default=0,
+                   help="pipeline microbatch size (pp meshes; 0 = "
+                        "global batch / (2*pp), giving 2*pp microbatches)")
     p.add_argument("--lr-schedule", choices=["constant", "cosine"],
                    default="constant",
                    help="cosine: linear warmup over --warmup-steps then "
@@ -197,6 +200,10 @@ def _resnet_workload(args, mesh, n_devices: int) -> Workload:
     )
 
 
+def _is_dense_llama(model: str) -> bool:
+    return model in ("llama3-8b", "llama-tiny")
+
+
 def llama_config_from_args(args, sp: int):
     """Build the LlamaConfig a CLI invocation asks for — separated from
     the workload builder so flag→config threading is unit-testable
@@ -218,6 +225,110 @@ def llama_config_from_args(args, sp: int):
     if args.model == "llama-moe-tiny":
         return lib.tiny_moe(**kw)
     return lib.tiny(**kw)
+
+
+def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
+    """Dense Llama with the blocks pipelined over pp (models/llama_pp)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import llama as lib
+    from ..models import llama_pp as pp_lib
+    from ..parallel import shard_batch
+
+    pp = sizes["pp"]
+    dp = sizes.get("dp", 1)
+    unsupported = [a for a, n in sizes.items()
+                   if a not in ("dp", "pp") and n > 1]
+    if unsupported:
+        raise SystemExit(
+            f"pp meshes compose with dp only; {unsupported} would "
+            f"silently replicate work/params (fsdp/tp/sp are not wired "
+            f"through the pipelined llama)"
+        )
+    if args.data:
+        raise SystemExit(
+            "--data is not wired through the pipelined llama workload "
+            "yet; drop --data or train without pp"
+        )
+    cfg = llama_config_from_args(args, sp=1)  # flash attention in stages
+    if args.grad_accum > 1:
+        raise SystemExit(
+            "--grad-accum with a pp mesh is redundant: raise the "
+            "microbatch count instead (lower --pp-microbatch)"
+        )
+    if cfg.n_layers % pp:
+        raise SystemExit(
+            f"model has {cfg.n_layers} layers, not divisible by pp={pp}"
+        )
+    mb = args.pp_microbatch
+    if not mb:
+        # Largest multiple-of-dp divisor of the global batch that yields
+        # at least 2*pp microbatches (pp as a fallback) — never derive a
+        # non-divisor and then abort over it.
+        divisors = [
+            d for d in range(1, global_batch + 1)
+            if global_batch % d == 0 and d % dp == 0
+        ]
+        for want in (2 * pp, pp):
+            fitting = [d for d in divisors if global_batch // d >= want]
+            if fitting:
+                mb = max(fitting)
+                break
+        if not mb:
+            raise SystemExit(
+                f"--global-batch {global_batch} cannot form {pp} pipeline "
+                f"microbatches of a multiple of dp={dp}; raise it"
+            )
+    if global_batch % mb:
+        raise SystemExit(
+            f"--global-batch {global_batch} not divisible by pipeline "
+            f"microbatch {mb}"
+        )
+    m = global_batch // mb
+    if m < pp:
+        raise SystemExit(
+            f"{m} pipeline microbatches cannot fill {pp} stages; lower "
+            f"--pp-microbatch or raise --global-batch"
+        )
+    if mb % dp:
+        raise SystemExit(
+            f"pipeline microbatch {mb} not divisible by dp={dp}"
+        )
+
+    model = lib.Llama(cfg)  # plain structure, used for init only
+    params0 = lib.init_params(model, jax.random.PRNGKey(args.seed))
+    params = pp_lib.shard_pp_params(
+        pp_lib.pp_params_from_init(params0, cfg, pp), mesh
+    )
+    # jit init so mu/nu inherit the params' shardings via GSPMD.
+    opt_state = jax.jit(optimizer.init)(params)
+
+    tokens = shard_batch(
+        jnp.asarray(
+            rng.randint(0, cfg.vocab_size, (global_batch, args.seq_len)),
+            jnp.int32,
+        ),
+        mesh,
+    )
+    raw_step = jax.jit(
+        pp_lib.make_pp_train_step(cfg, mesh, optimizer, mb),
+        donate_argnums=(0, 1),
+    )
+
+    def step_fn(state, batch):
+        params, opt_state, loss = raw_step(
+            state["params"], state["opt_state"], *batch
+        )
+        return {"params": params, "opt_state": opt_state}, loss
+
+    return Workload(
+        state={"params": params, "opt_state": opt_state},
+        step_fn=step_fn,
+        batch=(tokens,),
+        examples_per_step=global_batch,
+        mesh=mesh,
+    )
 
 
 def _lm_workload(args, mesh, n_devices: int) -> Workload:
@@ -267,6 +378,9 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
         )
         tokens = jnp.where(mask.astype(bool), 0, targets)
         batch = (tokens, mask, targets)
+    elif sizes.get("pp", 1) > 1:
+        return _llama_pp_workload(args, mesh, sizes, global_batch, rng,
+                                  optimizer)
     else:
         from ..models import llama as lib
 
@@ -381,12 +495,12 @@ def main(argv=None) -> int:
 
     devices = jax.devices()
     mesh_spec = parse_mesh_spec(args.mesh)
-    if mesh_spec.get("pp", 1) != 1:
-        # No stock workload consumes pp yet: the stages would silently
-        # replicate work (1/pp of the expected throughput). Refuse loudly.
+    if mesh_spec.get("pp", 1) != 1 and not _is_dense_llama(args.model):
+        # Only the dense Llama workload consumes pp (llama_pp.py); other
+        # stock workloads would silently replicate work. Refuse loudly.
         raise SystemExit(
-            "--mesh pp is not wired into the stock workloads; use the "
-            "parallel.run_pipeline API, or drop pp from --mesh"
+            "--mesh pp is wired for dense llama models only; use the "
+            "parallel.run_pipeline API for custom stages, or drop pp"
         )
     mesh = create_mesh(**mesh_spec)
     log.info(

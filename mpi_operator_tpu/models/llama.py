@@ -145,6 +145,18 @@ class RMSNorm(nn.Module):
         return (norm * scale).astype(x.dtype)
 
 
+def remat_policy_for(cfg: "LlamaConfig"):
+    """jax.checkpoint policy for cfg.remat_policy — shared by the plain
+    per-layer remat and the pipelined stage remat (llama_pp.py)."""
+    if cfg.remat_policy == "full":
+        return None
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(
+        f"remat_policy must be 'full' or 'dots', got {cfg.remat_policy!r}"
+    )
+
+
 def _use_zigzag(cfg: "LlamaConfig", mesh) -> bool:
     """The ONE decision for zigzag layout — the model-level permute and
     the per-layer ring call must always agree."""
@@ -284,16 +296,9 @@ class Llama(nn.Module):
             positions = jnp.broadcast_to(perm, tokens.shape)
         block = Block
         if cfg.remat:
-            if cfg.remat_policy == "full":
-                policy = None
-            elif cfg.remat_policy == "dots":
-                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            else:
-                raise ValueError(
-                    f"remat_policy must be 'full' or 'dots', got "
-                    f"{cfg.remat_policy!r}"
-                )
-            block = nn.remat(Block, static_argnums=(), policy=policy)
+            block = nn.remat(
+                Block, static_argnums=(), policy=remat_policy_for(cfg)
+            )
         aux_total = jnp.float32(0.0)
         for i in range(cfg.n_layers):
             h, aux = block(cfg, self.mesh, name=f"layer_{i}")(h, positions)

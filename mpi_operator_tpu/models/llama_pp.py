@@ -1,0 +1,139 @@
+"""Pipelined Llama: the transformer blocks run as a GPipe pipeline over
+the ``pp`` mesh axis (``parallel.pipeline``), completing the trainer's
+six-axis story for a real model family.
+
+Layout: embedding, final RMSNorm, and the LM head are computed on every
+device (replicated compute — they are a sliver of the FLOPs); the L
+blocks are stage-stacked ``[P, L/P, ...]`` and shard over ``pp``, with
+activations hopping stage→stage via ``lax.ppermute`` inside the GPipe
+scan. The microbatch dim can additionally shard over ``dp``. The whole
+thing differentiates end-to-end (the reversed scan IS the backward
+schedule), so the standard optimizer/accum plumbing applies unchanged.
+
+The reference delegates pipelining to user MPI programs entirely
+(SURVEY.md §2.4 "TP/PP/SP: absent"); this is the framework-owned
+equivalent, built as pure SPMD collectives.
+
+Restrictions: dense Llama only (MoE routes tokens through an ep
+all-to-all that would fight the stage ppermute), flash or dense
+attention inside stages (ring/ulysses own sp; pp x sp composition is
+not wired), and ``n_layers`` must divide by the pp size.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import DP, PP
+from ..parallel.pipeline import microbatch, pipeline, unmicrobatch
+from .llama import Block, LlamaConfig, RMSNorm, remat_policy_for
+
+
+def stack_block_params(params, n_layers: int, n_stages: int):
+    """Convert a standard Llama init's ``layer_i`` subtrees into the
+    stage-stacked pytree the pipeline wants: leaves [P, L/P, ...]."""
+    if n_layers % n_stages:
+        raise ValueError(
+            f"n_layers {n_layers} not divisible by pp stages {n_stages}"
+        )
+    layers = [params[f"layer_{i}"] for i in range(n_layers)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+    per = n_layers // n_stages
+    return jax.tree_util.tree_map(
+        lambda w: w.reshape((n_stages, per) + w.shape[1:]), stacked
+    )
+
+
+def pp_params_from_init(params, cfg: LlamaConfig, n_stages: int):
+    """Regroup a standard init into the pipelined layout:
+    {embed, blocks (stage-stacked), final_norm, lm_head}."""
+    out = {
+        "embed": params["embed"],
+        "blocks": stack_block_params(params, cfg.n_layers, n_stages),
+        "final_norm": params["final_norm"],
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head"] = params["lm_head"]
+    return out
+
+
+def shard_pp_params(pp_params, mesh):
+    """Blocks shard over pp on the stage dim; everything else replicates."""
+    blocks = jax.tree_util.tree_map(
+        lambda w: jax.device_put(w, NamedSharding(mesh, P(PP))),
+        pp_params["blocks"],
+    )
+    rest = {
+        k: jax.tree_util.tree_map(
+            lambda w: jax.device_put(w, NamedSharding(mesh, P())), v
+        )
+        for k, v in pp_params.items() if k != "blocks"
+    }
+    return {**rest, "blocks": blocks}
+
+
+def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
+    """Next-token CE with the blocks pipelined over pp. Params must be in
+    the ``pp_params_from_init`` layout. Honors ``cfg.xent_chunk`` and
+    ``cfg.remat`` (each layer inside a stage is checkpointed)."""
+    if cfg.is_moe:
+        raise ValueError("pipelined Llama supports dense configs only")
+    if cfg.attention_impl not in ("flash", "dense"):
+        raise ValueError(
+            f"pipelined Llama runs flash/dense attention inside stages, "
+            f"not {cfg.attention_impl!r}"
+        )
+    block = Block(cfg)
+    names = mesh.axis_names
+    state_spec = P(DP if DP in names else None, None, None)  # [mb, S, D]
+
+    def stage_fn(stage_params, h):
+        positions = jnp.broadcast_to(
+            jnp.arange(h.shape[1]), h.shape[:2]
+        )
+
+        def layer(carry, p_layer):
+            def run(carry):
+                out, _aux = block.apply({"params": p_layer}, carry, positions)
+                return out
+
+            if cfg.remat:
+                run = jax.checkpoint(run, policy=remat_policy_for(cfg))
+            return run(carry), None
+
+        h, _ = jax.lax.scan(layer, h, stage_params)
+        return h
+
+    def loss_fn(params, tokens):
+        emb = params["embed"]["embedding"]  # [V, D] f32
+        h = emb[tokens].astype(cfg.dtype)
+        x = microbatch(h, microbatch_size)  # [M, mb, S, D]
+        y = pipeline(
+            stage_fn, params["blocks"], x, mesh, state_spec=state_spec
+        )
+        h = unmicrobatch(y)
+        h = RMSNorm(cfg.norm_eps).apply(
+            {"params": params["final_norm"]}, h
+        )
+        w = (
+            params["embed"]["embedding"].T
+            if cfg.tie_embeddings
+            else params["lm_head"]["kernel"]
+        )
+        from ..ops.losses import lm_xent_chunked
+
+        chunk = cfg.xent_chunk if cfg.xent_chunk > 0 else tokens.shape[1]
+        return lm_xent_chunked(h[:, :-1], w, tokens[:, 1:], chunk=chunk)
+
+    return loss_fn
+
+
+def make_pp_train_step(cfg: LlamaConfig, mesh, optimizer,
+                       microbatch_size: int, accum_steps: int = 1):
+    from ..parallel.accum import make_update_step
+
+    return make_update_step(
+        make_pp_loss_fn(cfg, mesh, microbatch_size), optimizer, accum_steps
+    )
