@@ -103,6 +103,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pp-microbatch", type=int, default=0,
                    help="pipeline microbatch size (pp meshes; 0 = "
                         "global batch / (2*pp), giving 2*pp microbatches)")
+    p.add_argument("--mlm-layout", choices=["mask", "positions"],
+                   default="mask",
+                   help="BERT MLM batch layout: 'mask' scores all S "
+                        "positions (full [B,S,V] logits); 'positions' "
+                        "gathers the ~15%% masked slots before the head "
+                        "(the max_predictions_per_seq fast path)")
     p.add_argument("--lr-schedule", choices=["constant", "cosine"],
                    default="constant",
                    help="cosine: linear warmup over --warmup-steps then "
@@ -331,6 +337,26 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
     )
 
 
+def _mlm_positions_batch(rows, rand):
+    """Gathered-positions MLM batch from a token matrix and a uniform
+    [B, S] draw: the n_pred = max(1, 0.15*S) smallest-rand positions of
+    each row become its prediction slots (sorted), zeroed in the inputs.
+    Pure in (rows, rand), so any process count / resume derives the same
+    global batch — the same determinism contract as the mask layout.
+    Returns (positions, targets, inputs, weights)."""
+    import numpy as np
+
+    b, s = rows.shape
+    n_pred = max(int(s * 0.15), 1)
+    pos = np.sort(np.argsort(rand, axis=1)[:, :n_pred], axis=1)
+    tg = np.take_along_axis(rows, pos, axis=1)
+    inputs = rows.copy()
+    np.put_along_axis(inputs, pos, 0, axis=1)
+    return (
+        pos.astype(np.int32), tg, inputs, np.ones((b, n_pred), np.float32)
+    )
+
+
 def _lm_workload(args, mesh, n_devices: int) -> Workload:
     import jax
     import jax.numpy as jnp
@@ -359,25 +385,35 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
     rng = np.random.RandomState(args.seed)
 
     optimizer = optax.adamw(_make_learning_rate(args))
+    make_step = None
     if args.model.startswith("bert"):
         from ..models import bert as lib
 
         cfg = lib.bert_base() if args.model == "bert-base" else lib.tiny()
         model = lib.Bert(cfg)
         params = lib.init_params(model, jax.random.PRNGKey(args.seed))
-        targets = shard_batch(
-            jnp.asarray(
-                rng.randint(0, cfg.vocab_size, (global_batch, args.seq_len)),
-                jnp.int32,
-            ),
-            mesh,
-        )
-        mask = shard_batch(
-            jnp.asarray(rng.rand(global_batch, args.seq_len) < 0.15, jnp.float32),
-            mesh,
-        )
-        tokens = jnp.where(mask.astype(bool), 0, targets)
-        batch = (tokens, mask, targets)
+        rows = rng.randint(0, cfg.vocab_size, (global_batch, args.seq_len))
+        if args.mlm_layout == "positions":
+            pos, tg, inputs, w = _mlm_positions_batch(
+                rows, rng.rand(global_batch, args.seq_len)
+            )
+            batch = (
+                shard_batch(jnp.asarray(inputs, jnp.int32), mesh),
+                shard_batch(jnp.asarray(pos, jnp.int32), mesh),
+                shard_batch(jnp.asarray(tg, jnp.int32), mesh),
+                shard_batch(jnp.asarray(w, jnp.float32), mesh),
+            )
+            make_step = lib.make_train_step_positions
+        else:
+            targets = shard_batch(jnp.asarray(rows, jnp.int32), mesh)
+            mask = shard_batch(
+                jnp.asarray(
+                    rng.rand(global_batch, args.seq_len) < 0.15, jnp.float32
+                ),
+                mesh,
+            )
+            tokens = jnp.where(mask.astype(bool), 0, targets)
+            batch = (tokens, mask, targets)
     elif sizes.get("pp", 1) > 1:
         return _llama_pp_workload(args, mesh, sizes, global_batch, rng,
                                   optimizer)
@@ -408,7 +444,9 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
     params = shard_params(params, mesh, rules=rules)
     opt_state = shard_params(optimizer.init(params), mesh, rules=rules)
     raw_step = jax.jit(
-        lib.make_train_step(model, optimizer, accum_steps=args.grad_accum),
+        (make_step or lib.make_train_step)(
+            model, optimizer, accum_steps=args.grad_accum
+        ),
         donate_argnums=(0, 1),
     )
 
@@ -445,16 +483,25 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
             ).astype(np.int64) % vocab
             if not is_bert:
                 return (to_global(jnp.asarray(rows, jnp.int32)),)
-            # MLM masking: drawn for the GLOBAL batch and sliced to this
-            # process's rows, so the mask of a global row is pure in
-            # (seed, step, row) — identical across any process count,
-            # which keeps resume-on-a-different-gang bit-exact (same
-            # contract as the token stream itself).
+            # MLM randomness: drawn for the GLOBAL batch and sliced to
+            # this process's rows, so each global row's mask/positions
+            # are pure in (seed, step, row) — identical across any
+            # process count, which keeps resume-on-a-different-gang
+            # bit-exact (same contract as the token stream itself).
             mrng = np.random.RandomState(args.seed + step)
             per = global_batch // pc
-            m = (mrng.rand(global_batch, rows.shape[1]) < 0.15)[
+            rand = mrng.rand(global_batch, rows.shape[1])[
                 pi * per:(pi + 1) * per
             ]
+            if args.mlm_layout == "positions":
+                pos, tg, inputs, w = _mlm_positions_batch(rows, rand)
+                return (
+                    to_global(jnp.asarray(inputs, jnp.int32)),
+                    to_global(jnp.asarray(pos, jnp.int32)),
+                    to_global(jnp.asarray(tg, jnp.int32)),
+                    to_global(jnp.asarray(w, jnp.float32)),
+                )
+            m = rand < 0.15
             inputs = to_global(jnp.asarray(np.where(m, 0, rows), jnp.int32))
             mask = to_global(jnp.asarray(m, jnp.float32))
             targets = to_global(jnp.asarray(rows, jnp.int32))

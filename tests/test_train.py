@@ -67,6 +67,14 @@ class TestTrainModels:
         )
         assert m["final_step"] == 3
 
+    def test_bert_tiny_positions_layout(self, capsys):
+        m = run_train(
+            capsys, "--model", "bert-tiny", "--steps", "3", "--warmup", "1",
+            "--mlm-layout", "positions", "--global-batch", "8",
+            "--seq-len", "32", "--log-every", "0",
+        )
+        assert m["final_step"] == 3
+
     def test_flags_thread_into_llama_config(self):
         """Flag→config threading, unit-level: CLI-scale models run with
         remat=False, so an e2e run cannot notice a dropped
@@ -127,6 +135,23 @@ class TestRealDataTraining:
             capsys, "--model", "bert-tiny", "--steps", "3", "--warmup", "1",
             "--global-batch", "8", "--seq-len", "32", "--log-every", "0",
             "--data", str(path),
+        )
+        assert m["final_step"] == 3 and m["loss"] is not None
+
+    def test_bert_positions_layout_from_token_file(self, capsys, tmp_path):
+        import numpy as np
+
+        from mpi_operator_tpu.data import write_token_file
+
+        path = tmp_path / "corpus.bin"
+        write_token_file(
+            path, np.random.RandomState(2).randint(
+                0, 120, size=64 * 32).astype(np.uint32),
+        )
+        m = run_train(
+            capsys, "--model", "bert-tiny", "--steps", "3", "--warmup", "1",
+            "--global-batch", "8", "--seq-len", "32", "--log-every", "0",
+            "--data", str(path), "--mlm-layout", "positions",
         )
         assert m["final_step"] == 3 and m["loss"] is not None
 
