@@ -244,12 +244,13 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
 
     pp = sizes["pp"]
     dp = sizes.get("dp", 1)
+    fsdp = sizes.get("fsdp", 1)
     unsupported = [a for a, n in sizes.items()
-                   if a not in ("dp", "pp") and n > 1]
+                   if a not in ("dp", "fsdp", "pp") and n > 1]
     if unsupported:
         raise SystemExit(
-            f"pp meshes compose with dp only; {unsupported} would "
-            f"silently replicate work/params (fsdp/tp/sp are not wired "
+            f"pp meshes compose with dp and fsdp only; {unsupported} "
+            f"would silently replicate work/params (tp/sp are not wired "
             f"through the pipelined llama)"
         )
     if args.data:
@@ -267,14 +268,22 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
         raise SystemExit(
             f"model has {cfg.n_layers} layers, not divisible by pp={pp}"
         )
+    if fsdp > 1 and (cfg.dim % fsdp or cfg.ffn_dim % fsdp):
+        # Every block leaf's first weight dim is dim or ffn_dim
+        # (llama_pp._block_leaf_spec) — both must split over fsdp.
+        raise SystemExit(
+            f"model dims (dim={cfg.dim}, ffn_dim={cfg.ffn_dim}) must "
+            f"both divide by fsdp={fsdp}"
+        )
     mb = args.pp_microbatch
     if not mb:
-        # Largest multiple-of-dp divisor of the global batch that yields
-        # at least 2*pp microbatches (pp as a fallback) — never derive a
-        # non-divisor and then abort over it.
+        # Largest multiple-of-(dp*fsdp) divisor of the global batch that
+        # yields at least 2*pp microbatches (pp as a fallback) — never
+        # derive a non-divisor and then abort over it.
+        shards = dp * fsdp
         divisors = [
             d for d in range(1, global_batch + 1)
-            if global_batch % d == 0 and d % dp == 0
+            if global_batch % d == 0 and d % shards == 0
         ]
         for want in (2 * pp, pp):
             fitting = [d for d in divisors if global_batch // d >= want]
@@ -284,7 +293,7 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
         if not mb:
             raise SystemExit(
                 f"--global-batch {global_batch} cannot form {pp} pipeline "
-                f"microbatches of a multiple of dp={dp}; raise it"
+                f"microbatches of a multiple of dp*fsdp={shards}; raise it"
             )
     if global_batch % mb:
         raise SystemExit(
@@ -297,9 +306,10 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
             f"{m} pipeline microbatches cannot fill {pp} stages; lower "
             f"--pp-microbatch or raise --global-batch"
         )
-    if mb % dp:
+    if mb % (dp * fsdp):
         raise SystemExit(
-            f"pipeline microbatch {mb} not divisible by dp={dp}"
+            f"pipeline microbatch {mb} not divisible by dp*fsdp="
+            f"{dp * fsdp} (microbatch rows shard over both)"
         )
 
     model = lib.Llama(cfg)  # plain structure, used for init only

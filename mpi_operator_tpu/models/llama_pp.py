@@ -6,9 +6,14 @@ Layout: embedding, final RMSNorm, and the LM head are computed on every
 device (replicated compute — they are a sliver of the FLOPs); the L
 blocks are stage-stacked ``[P, L/P, ...]`` and shard over ``pp``, with
 activations hopping stage→stage via ``lax.ppermute`` inside the GPipe
-scan. The microbatch dim can additionally shard over ``dp``. The whole
-thing differentiates end-to-end (the reversed scan IS the backward
-schedule), so the standard optimizer/accum plumbing applies unchanged.
+scan. The microbatch dim can additionally shard over ``dp``, and on a
+mesh with an ``fsdp`` axis the block weights ALSO shard ZeRO-3-style
+over fsdp (first weight dim): each stage all-gathers one layer's
+weights just before using it, and AD's transpose of that gather is the
+reduce-scatter that keeps gradients sharded — GPipe x ZeRO-3 with two
+explicit collectives. The whole thing differentiates end-to-end (the
+reversed scan IS the backward schedule), so the standard
+optimizer/accum plumbing applies unchanged.
 
 The reference delegates pipelining to user MPI programs entirely
 (SURVEY.md §2.4 "TP/PP/SP: absent"); this is the framework-owned
@@ -17,7 +22,8 @@ equivalent, built as pure SPMD collectives.
 Restrictions: dense Llama only (MoE routes tokens through an ep
 all-to-all that would fight the stage ppermute), flash or dense
 attention inside stages (ring/ulysses own sp; pp x sp composition is
-not wired), and ``n_layers`` must divide by the pp size.
+not wired), ``n_layers`` must divide by the pp size, and fsdp sharding
+covers the blocks (embed/head replicate).
 """
 
 from __future__ import annotations
@@ -26,9 +32,21 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import DP, PP
+from ..parallel.mesh import DP, FSDP, PP
 from ..parallel.pipeline import microbatch, pipeline, unmicrobatch
 from .llama import Block, LlamaConfig, RMSNorm, remat_policy_for
+
+
+def _fsdp_size(mesh) -> int:
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return sizes.get(FSDP, 1)
+
+
+def _block_leaf_spec(leaf) -> P:
+    """Spec for one stage-stacked block leaf [P, L/P, d, ...]: stage dim
+    over pp, the first weight dim over fsdp (ZeRO-3 storage; stages
+    all-gather a layer's weights just before using it)."""
+    return P(PP, None, FSDP, *([None] * (leaf.ndim - 3)))
 
 
 def stack_block_params(params, n_layers: int, n_stages: int):
@@ -60,9 +78,17 @@ def pp_params_from_init(params, cfg: LlamaConfig, n_stages: int):
 
 
 def shard_pp_params(pp_params, mesh):
-    """Blocks shard over pp on the stage dim; everything else replicates."""
+    """Blocks shard over pp on the stage dim — and, when the mesh has an
+    fsdp axis, over fsdp on the first weight dim (ZeRO-3 storage; the
+    stage loop all-gathers one layer at a time). Embed/norm/head
+    replicate: they are used on every stage and are a sliver of the
+    block weights for deep models."""
+    fsdp = _fsdp_size(mesh) > 1
     blocks = jax.tree_util.tree_map(
-        lambda w: jax.device_put(w, NamedSharding(mesh, P(PP))),
+        lambda w: jax.device_put(
+            w,
+            NamedSharding(mesh, _block_leaf_spec(w) if fsdp else P(PP)),
+        ),
         pp_params["blocks"],
     )
     rest = {
@@ -87,7 +113,12 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
         )
     block = Block(cfg)
     names = mesh.axis_names
-    state_spec = P(DP if DP in names else None, None, None)  # [mb, S, D]
+    fsdp = _fsdp_size(mesh) > 1
+    # Microbatch rows shard over every batch axis (dp AND fsdp — the
+    # same layout shard_batch produces); leaving fsdp off forces XLA to
+    # replicate-and-repartition activations at the shard_map boundary.
+    batch_axes = tuple(a for a in (DP, FSDP) if a in names)
+    state_spec = P(batch_axes if batch_axes else None, None, None)
 
     def stage_fn(stage_params, h):
         positions = jnp.broadcast_to(
@@ -96,7 +127,21 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
 
         def layer(carry, p_layer):
             def run(carry):
-                out, _aux = block.apply({"params": p_layer}, carry, positions)
+                if fsdp:
+                    # ZeRO-3 moment: materialize THIS layer's full
+                    # weights from their fsdp shards; under remat the
+                    # gather replays in backward, so full weights never
+                    # persist. AD's transpose of the gather is the
+                    # reduce-scatter that keeps grads sharded.
+                    p_full = jax.tree_util.tree_map(
+                        lambda w: jax.lax.all_gather(
+                            w, FSDP, axis=0, tiled=True
+                        ),
+                        p_layer,
+                    )
+                else:
+                    p_full = p_layer
+                out, _aux = block.apply({"params": p_full}, carry, positions)
                 return out
 
             if cfg.remat:
@@ -111,7 +156,10 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
         h = emb[tokens].astype(cfg.dtype)
         x = microbatch(h, microbatch_size)  # [M, mb, S, D]
         y = pipeline(
-            stage_fn, params["blocks"], x, mesh, state_spec=state_spec
+            stage_fn, params["blocks"], x, mesh, state_spec=state_spec,
+            params_spec=jax.tree_util.tree_map(
+                _block_leaf_spec, params["blocks"]
+            ) if fsdp else None,
         )
         h = unmicrobatch(y)
         h = RMSNorm(cfg.norm_eps).apply(

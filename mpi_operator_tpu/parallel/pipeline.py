@@ -49,6 +49,7 @@ def pipeline(
     *,
     axis: str = PP,
     state_spec: Optional[P] = None,
+    params_spec=None,
 ):
     """Run ``fn`` as a P-stage pipeline over microbatched input.
 
@@ -61,11 +62,26 @@ def pipeline(
     state_spec:    PartitionSpec of ONE microbatch [mb, ...] over the
                    non-pp axes (e.g. P(('dp',), None) to ride dp);
                    defaults to fully replicated.
+    params_spec:   optional pytree of PartitionSpecs for stage_params
+                   (matching its structure; every leaf spec must lead
+                   with ``axis`` on the stage dim). Lets callers shard
+                   the non-stage dims too — e.g. ZeRO-3 weight sharding
+                   over fsdp, with ``fn`` doing the all-gather. Default:
+                   every leaf P(axis) (stage dim only, rest replicated).
 
     Returns [M, mb, ...] outputs (replicated over ``axis``).
     """
     if axis not in mesh.axis_names:
         # No pp axis: run the stages sequentially (the pipeline of one).
+        if params_spec is not None:
+            # fn built for sharded params (e.g. it all-gathers over
+            # fsdp) cannot run outside shard_map — fail loudly instead
+            # of an opaque unbound-axis trace error.
+            raise ValueError(
+                f"params_spec requires a {axis!r} mesh axis; the "
+                f"sequential fallback runs fn on unsharded params"
+            )
+
         def seq(h_all):
             n_stages = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
             for i in range(n_stages):
@@ -92,9 +108,17 @@ def pipeline(
         )
     state_spec = state_spec if state_spec is not None else P()
     x_spec = P(None, *state_spec)  # [M, mb, ...]: microbatch dim replicated
-    params_spec = jax.tree_util.tree_map(
-        lambda _: P(axis), stage_params
-    )
+    if params_spec is None:
+        params_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    else:
+        for spec in jax.tree_util.tree_leaves(
+            params_spec, is_leaf=lambda s: isinstance(s, P)
+        ):
+            if not spec or spec[0] != axis:
+                raise ValueError(
+                    f"every params_spec leaf must lead with {axis!r} on "
+                    f"the stage dim, got {spec}"
+                )
 
     def per_shard(params_me, x_all):
         # params_me leaves keep a leading stage dim of 1 — squeeze it.
