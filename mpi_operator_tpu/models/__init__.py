@@ -6,10 +6,15 @@ examples, pi.cc).
 - ``resnet``: ResNet v1.5 (the headline benchmark family, BASELINE.md).
 - ``bert``: BERT-base encoder, MLM pretraining (milestone config 3).
 - ``llama``: Llama-family decoder with FSDP/TP/SP shardings and
-  flash/ring attention (milestone config 4).
+  flash/ring/ulysses attention (milestone config 4).
+- ``llama_pp``: the same blocks as a GPipe pipeline over pp (x ZeRO-3
+  fsdp weight sharding).
+- ``moe``: Mixtral-style sparse MoE layer, experts over ep.
+- ``generate``: KV-cache autoregressive decoding for llama (static
+  shapes, one scanned program for prefill + generation).
 """
 
 # No eager submodule imports: consumers import the single model family
 # they need (bench.py / __graft_entry__ pull resnet only, inside
 # functions) without paying for flax/optax/pallas of the others.
-__all__ = ["bert", "llama", "resnet"]
+__all__ = ["bert", "generate", "llama", "llama_pp", "moe", "resnet"]

@@ -1,0 +1,180 @@
+"""Autoregressive decoding for Llama with a static KV cache.
+
+The reference operator has no model code at all (user images own the
+math — SURVEY.md §2.4); the framework ships training AND inference for
+its model families. Decoding is built the TPU way:
+
+- **static shapes**: the KV cache is preallocated [B, H_kv, S_max, D]
+  and written in place with ``lax.dynamic_update_slice``; attention
+  always scores against the full cache with a position mask. One
+  compiled program serves each (prompt length, max_new) shape pair —
+  bucket/pad prompts on the host to bound the number of compilations;
+- **lax.scan over steps**: prompt prefill and new-token generation are
+  the same scanned single-token step (teacher-forced for the prompt,
+  argmax/sample after), no Python loop, no retracing;
+- **GQA-aware**: cache stores the n_kv_heads, query heads map onto
+  them group-wise, kv never expands in HBM.
+
+The decode math re-implements the block forward functionally (the
+training path runs whole sequences through flax modules; decode runs
+one position against the cache). Equivalence is pinned by test:
+teacher-forced decode logits must match the training forward exactly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import NEG_INF
+from .llama import LlamaConfig, _rope
+
+
+def _rms(x, scale, eps):
+    xf = x.astype(jnp.float32)
+    norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (norm * scale).astype(x.dtype)
+
+
+def _attn_step(p, cache_k, cache_v, x, pos, cfg: LlamaConfig):
+    """One position through one attention block. x: [B, D]; cache_k/v:
+    [B, H_kv, S_max, Dh]; pos: scalar index. Returns (out, k', v')."""
+    b, _ = x.shape
+    hd = cfg.head_dim
+    q = (x @ p["wq"]["kernel"].astype(cfg.dtype)).reshape(
+        b, cfg.n_heads, hd
+    )
+    k = (x @ p["wk"]["kernel"].astype(cfg.dtype)).reshape(
+        b, cfg.n_kv_heads, hd
+    )
+    v = (x @ p["wv"]["kernel"].astype(cfg.dtype)).reshape(
+        b, cfg.n_kv_heads, hd
+    )
+    positions = jnp.full((b, 1), pos)
+    q = _rope(q[:, None], positions, cfg.rope_theta)[:, 0]
+    k = _rope(k[:, None], positions, cfg.rope_theta)[:, 0]
+
+    cache_k = jax.lax.dynamic_update_slice(
+        cache_k, k[:, :, None].astype(cache_k.dtype), (0, 0, pos, 0)
+    )
+    cache_v = jax.lax.dynamic_update_slice(
+        cache_v, v[:, :, None].astype(cache_v.dtype), (0, 0, pos, 0)
+    )
+
+    groups = cfg.n_heads // cfg.n_kv_heads
+    qg = q.reshape(b, cfg.n_kv_heads, groups, hd)
+    scores = jnp.einsum(
+        "bkgd,bksd->bkgs", qg.astype(jnp.float32),
+        cache_k.astype(jnp.float32),
+    ) * (hd ** -0.5)
+    s_max = cache_k.shape[2]
+    mask = jnp.arange(s_max)[None, None, None, :] <= pos
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgs,bksd->bkgd", probs, cache_v.astype(jnp.float32)
+    ).reshape(b, cfg.n_heads * hd).astype(cfg.dtype)
+    return out @ p["wo"]["kernel"].astype(cfg.dtype), cache_k, cache_v
+
+
+def _mlp_step(p, x, cfg: LlamaConfig):
+    gate = x @ p["w_gate"]["kernel"].astype(cfg.dtype)
+    up = x @ p["w_up"]["kernel"].astype(cfg.dtype)
+    return (jax.nn.silu(gate) * up) @ p["w_down"]["kernel"].astype(cfg.dtype)
+
+
+def _decode_step(params, cfg: LlamaConfig, caches, token, pos):
+    """One token through the whole model. token: [B] int; caches: list of
+    (k, v) per layer. Returns (logits [B, V] f32, new caches)."""
+    x = params["embed"]["embedding"][token].astype(cfg.dtype)  # [B, D]
+    new_caches = []
+    for i in range(cfg.n_layers):
+        p = params[f"layer_{i}"]
+        h = _rms(x, p["attn_norm"]["scale"], cfg.norm_eps)
+        a, ck, cv = _attn_step(
+            p["attn"], caches[i][0], caches[i][1], h, pos, cfg
+        )
+        x = x + a
+        h = _rms(x, p["mlp_norm"]["scale"], cfg.norm_eps)
+        x = x + _mlp_step(p["mlp"], h, cfg)
+        new_caches.append((ck, cv))
+    x = _rms(x, params["final_norm"]["scale"], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        w = params["embed"]["embedding"].T
+    else:
+        w = params["lm_head"]["kernel"]
+    logits = x.astype(jnp.float32) @ w.astype(jnp.float32)
+    return logits, new_caches
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=None):
+    dtype = dtype or cfg.dtype
+    return [
+        (
+            jnp.zeros((batch, cfg.n_kv_heads, max_len, cfg.head_dim), dtype),
+            jnp.zeros((batch, cfg.n_kv_heads, max_len, cfg.head_dim), dtype),
+        )
+        for _ in range(cfg.n_layers)
+    ]
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new", "sample"))
+def _generate_impl(params, prompt, cfg, max_new, sample, temperature, rng):
+    b, s0 = prompt.shape
+    total = s0 + max_new
+    caches = init_cache(cfg, b, total)
+
+    def step(carry, t):
+        caches, token, rng = carry
+        logits, caches = _decode_step(params, cfg, caches, token, t)
+        if sample:
+            rng, sub = jax.random.split(rng)
+            chosen = jax.random.categorical(sub, logits / temperature)
+        else:
+            chosen = jnp.argmax(logits, axis=-1)
+        # Teacher-force while still inside the prompt.
+        in_prompt = t + 1 < s0
+        next_token = jnp.where(
+            in_prompt,
+            prompt[:, jnp.minimum(t + 1, s0 - 1)],
+            chosen.astype(prompt.dtype),
+        )
+        return (caches, next_token, rng), next_token
+
+    init = (caches, prompt[:, 0], rng)
+    _, emitted = jax.lax.scan(step, init, jnp.arange(total - 1))
+    # emitted[t] is the token at position t+1.
+    return jnp.concatenate([prompt[:, :1], emitted.T], axis=1)
+
+
+def generate(
+    params,
+    prompt,
+    cfg: LlamaConfig,
+    *,
+    max_new: int,
+    temperature: float = 0.0,
+    rng=None,
+):
+    """Decode ``max_new`` tokens after ``prompt`` [B, S0]. One compiled
+    scan covers prefill + generation: for the first S0-1 steps the next
+    input is the teacher-forced prompt token, afterwards the model's own
+    prediction. temperature 0 = greedy; > 0 = softmax sampling (needs
+    ``rng``; the temperature itself is a traced operand, so sweeping it
+    does not recompile). Returns [B, S0 + max_new] tokens. Dense configs
+    only (MoE routing has no decode path yet)."""
+    if cfg.is_moe:
+        raise NotImplementedError(
+            "generate() supports dense Llama configs; MoE decoding is "
+            "not implemented"
+        )
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng key")
+    sample = rng is not None and temperature > 0
+    return _generate_impl(
+        params, prompt, cfg, max_new, sample,
+        jnp.float32(temperature if sample else 1.0),
+        rng if rng is not None else jax.random.PRNGKey(0),
+    )
