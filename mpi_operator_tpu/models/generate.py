@@ -85,6 +85,29 @@ def _mlp_step(p, x, cfg: LlamaConfig):
     return (jax.nn.silu(gate) * up) @ p["w_down"]["kernel"].astype(cfg.dtype)
 
 
+def _moe_step(p, x, cfg: LlamaConfig):
+    """One position through a sparse-MoE FFN. At decode each token
+    routes alone, so there is no capacity competition and no drops: the
+    exact training semantics reduce to a dense all-experts einsum
+    weighted by the normalized top-k gates (static shapes; computes all
+    E experts — the TPU-friendly trade for a batch-1-per-token path)."""
+    from .moe import topk_gates
+
+    probs = jax.nn.softmax(
+        x.astype(jnp.float32) @ p["router"], axis=-1
+    )  # [B, E]
+    _, _, w = topk_gates(probs, cfg.moe_top_k)  # [B, E] dense weights
+    hg = jnp.einsum("bd,edf->bef", x, p["expert_wg"].astype(cfg.dtype))
+    hu = jnp.einsum("bd,edf->bef", x, p["expert_wu"].astype(cfg.dtype))
+    h = jax.nn.silu(hg) * hu
+    out_e = jnp.einsum(
+        "bef,efd->bed", h, p["expert_wd"].astype(cfg.dtype)
+    )
+    return jnp.einsum(
+        "be,bed->bd", w, out_e.astype(jnp.float32)
+    ).astype(x.dtype)
+
+
 def _decode_step(params, cfg: LlamaConfig, caches, token, pos):
     """One token through the whole model. token: [B] int; caches: list of
     (k, v) per layer. Returns (logits [B, V] f32, new caches)."""
@@ -98,7 +121,10 @@ def _decode_step(params, cfg: LlamaConfig, caches, token, pos):
         )
         x = x + a
         h = _rms(x, p["mlp_norm"]["scale"], cfg.norm_eps)
-        x = x + _mlp_step(p["mlp"], h, cfg)
+        if cfg.is_moe:
+            x = x + _moe_step(p["moe"], h, cfg)
+        else:
+            x = x + _mlp_step(p["mlp"], h, cfg)
         new_caches.append((ck, cv))
     x = _rms(x, params["final_norm"]["scale"], cfg.norm_eps)
     if cfg.tie_embeddings:
@@ -163,13 +189,8 @@ def generate(
     input is the teacher-forced prompt token, afterwards the model's own
     prediction. temperature 0 = greedy; > 0 = softmax sampling (needs
     ``rng``; the temperature itself is a traced operand, so sweeping it
-    does not recompile). Returns [B, S0 + max_new] tokens. Dense configs
-    only (MoE routing has no decode path yet)."""
-    if cfg.is_moe:
-        raise NotImplementedError(
-            "generate() supports dense Llama configs; MoE decoding is "
-            "not implemented"
-        )
+    does not recompile). Returns [B, S0 + max_new] tokens. MoE configs
+    decode via the dense all-experts path (``_moe_step``)."""
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng key")
     sample = rng is not None and temperature > 0

@@ -48,6 +48,25 @@ def expert_capacity(
     return max(1, math.ceil(top_k * tokens_per_group / n_experts * capacity_factor))
 
 
+def topk_gates(probs, top_k: int, *, normalize: bool = True):
+    """Top-k selection + the gate-weight convention, single-sourced for
+    the training dispatch (``routing``) and the decode path
+    (``generate._moe_step``): returns (gates [..., K], idx [..., K],
+    dense [..., E] combine weights). ``normalize=True`` is the Mixtral
+    convention (selected gates sum to 1)."""
+    e = probs.shape[-1]
+    gates, idx = jax.lax.top_k(probs, top_k)
+    if normalize:
+        gates = gates / jnp.maximum(
+            jnp.sum(gates, axis=-1, keepdims=True), 1e-9
+        )
+    dense_w = jnp.sum(
+        jax.nn.one_hot(idx, e, dtype=probs.dtype) * gates[..., None],
+        axis=-2,
+    )
+    return gates, idx, dense_w
+
+
 def routing(probs, top_k: int, capacity: int, *, normalize: bool = True):
     """Static-shape top-k routing → (dispatch, combine, aux_loss).
 
@@ -57,11 +76,7 @@ def routing(probs, top_k: int, capacity: int, *, normalize: bool = True):
     drop it under pressure.
     """
     g, s, e = probs.shape
-    gates, idx = jax.lax.top_k(probs, top_k)  # [G, S, K]
-    if normalize:  # Mixtral convention: selected gates sum to 1
-        gates = gates / jnp.maximum(
-            jnp.sum(gates, axis=-1, keepdims=True), 1e-9
-        )
+    gates, idx, _ = topk_gates(probs, top_k, normalize=normalize)
 
     oh = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [G, S, K, E]
     # Slot assignment: cumulative count over (k, s) within each group.

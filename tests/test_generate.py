@@ -32,14 +32,27 @@ def _greedy_reference(model, params, prompt, max_new):
 
 
 class TestGenerate:
-    def test_teacher_forced_logits_match_training_forward(self, setup):
+    @pytest.mark.parametrize("moe", [False, True])
+    def test_teacher_forced_logits_match_training_forward(self, setup, moe):
         """The decode path's LOGITS (not just argmaxes) must equal the
         training forward at every prompt position — catches
-        value-perturbing bugs that preserve the argmax."""
+        value-perturbing bugs that preserve the argmax. Runs the dense
+        AND the MoE config (capacity raised so training drops nothing —
+        the regime where decode is the exact same function)."""
         from mpi_operator_tpu.models.generate import _decode_step, init_cache
 
-        cfg, model, params, prompt = setup
-        want = model.apply({"params": params}, prompt)  # [B, S0, V]
+        if moe:
+            cfg = llama_lib.tiny_moe(capacity_factor=8.0)
+            model = llama_lib.Llama(cfg)
+            params = llama_lib.init_params(model, jax.random.PRNGKey(3))
+            prompt = jnp.asarray(
+                np.random.RandomState(1).randint(1, cfg.vocab_size, (2, 5)),
+                jnp.int32,
+            )
+            want, _aux = model.apply({"params": params}, prompt)
+        else:
+            cfg, model, params, prompt = setup
+            want = model.apply({"params": params}, prompt)  # [B, S0, V]
         caches = init_cache(cfg, prompt.shape[0], prompt.shape[1])
         for t in range(prompt.shape[1]):
             logits, caches = _decode_step(
@@ -50,10 +63,23 @@ class TestGenerate:
                 atol=1e-5, rtol=1e-5,
             )
 
-    def test_moe_config_rejected(self):
-        cfg = llama_lib.tiny_moe()
-        with pytest.raises(NotImplementedError, match="MoE"):
-            generate({}, jnp.zeros((1, 2), jnp.int32), cfg, max_new=1)
+    def test_moe_greedy_matches_full_forward(self):
+        """MoE decode (dense all-experts einsum weighted by top-k gates)
+        must match the training MoE forward. capacity_factor is raised
+        so training drops nothing — then the two paths are exactly the
+        same function."""
+        cfg = llama_lib.tiny_moe(capacity_factor=8.0)
+        model = llama_lib.Llama(cfg)
+        params = llama_lib.init_params(model, jax.random.PRNGKey(3))
+        prompt = jnp.asarray([[4, 9, 1], [2, 2, 7]], jnp.int32)
+
+        tokens = prompt
+        for _ in range(5):
+            logits, _aux = model.apply({"params": params}, tokens)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(tokens.dtype)
+            tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+        got = generate(params, prompt, cfg, max_new=5)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(tokens))
 
     def test_greedy_matches_full_forward(self, setup):
         cfg, model, params, prompt = setup
