@@ -23,7 +23,10 @@ Restrictions: dense Llama only (MoE routes tokens through an ep
 all-to-all that would fight the stage ppermute), flash or dense
 attention inside stages (ring/ulysses own sp; pp x sp composition is
 not wired), ``n_layers`` must divide by the pp size, and fsdp sharding
-covers the blocks (embed/head replicate).
+covers the blocks (embed/head replicate). Checkpoints hold the
+stage-stacked [P, L/P, ...] layout: resume on the same pp size is
+shape-identical; resuming onto a DIFFERENT pp size needs a restack
+(unstack to [L, ...] and re-split — the layer order is pp-invariant).
 """
 
 from __future__ import annotations
