@@ -396,31 +396,49 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
 
     optimizer = optax.adamw(_make_learning_rate(args))
     make_step = None
+    seq_ax = 1 if sp > 1 else None  # [B, S] arrays shard S over sp
     if args.model.startswith("bert"):
         from ..models import bert as lib
 
-        cfg = lib.bert_base() if args.model == "bert-base" else lib.tiny()
-        model = lib.Bert(cfg)
-        params = lib.init_params(model, jax.random.PRNGKey(args.seed))
+        attention = {} if sp == 1 else {
+            "attention_impl": args.sequence_parallel
+        }
+        cfg = (
+            lib.bert_base(**attention) if args.model == "bert-base"
+            else lib.tiny(**attention)
+        )
+        model = lib.Bert(cfg, mesh=mesh)
+        with mesh:
+            # Init shapes must satisfy the mesh: sp attention traces a
+            # shard_map at init.
+            params = lib.init_params(
+                model, jax.random.PRNGKey(args.seed),
+                batch=max(2, batch_shards),
+                seq=min(max(16, sp * 16), cfg.max_seq_len),
+            )
         rows = rng.randint(0, cfg.vocab_size, (global_batch, args.seq_len))
         if args.mlm_layout == "positions":
             pos, tg, inputs, w = _mlm_positions_batch(
                 rows, rng.rand(global_batch, args.seq_len)
             )
             batch = (
-                shard_batch(jnp.asarray(inputs, jnp.int32), mesh),
+                shard_batch(jnp.asarray(inputs, jnp.int32), mesh,
+                            sequence_axis=seq_ax),
+                # [B, P] prediction-slot arrays shard over batch only.
                 shard_batch(jnp.asarray(pos, jnp.int32), mesh),
                 shard_batch(jnp.asarray(tg, jnp.int32), mesh),
                 shard_batch(jnp.asarray(w, jnp.float32), mesh),
             )
             make_step = lib.make_train_step_positions
         else:
-            targets = shard_batch(jnp.asarray(rows, jnp.int32), mesh)
+            targets = shard_batch(jnp.asarray(rows, jnp.int32), mesh,
+                                  sequence_axis=seq_ax)
             mask = shard_batch(
                 jnp.asarray(
                     rng.rand(global_batch, args.seq_len) < 0.15, jnp.float32
                 ),
                 mesh,
+                sequence_axis=seq_ax,
             )
             tokens = jnp.where(mask.astype(bool), 0, targets)
             batch = (tokens, mask, targets)
@@ -475,16 +493,18 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
 
         is_bert = args.model.startswith("bert")
         ds = TokenDataset(args.data, args.seq_len, seed=args.seed)
-        seq_axis = 1 if (sp > 1 and not is_bert) else None
-        sharding = NamedSharding(mesh, batch_spec(mesh, sequence_axis=seq_axis))
+        # [B, S] arrays shard S over sp; [B, P] prediction-slot arrays
+        # (positions layout) shard over batch only.
+        sharding = NamedSharding(mesh, batch_spec(mesh, sequence_axis=seq_ax))
+        sharding_rows = NamedSharding(mesh, batch_spec(mesh))
         vocab = cfg.vocab_size
 
-        def to_global(rows):
+        def to_global(rows, shd=sharding):
             # Each process assembled exactly its rows (the Feistel order
             # is stateless); single-process takes the device_put shortcut.
             if jax.process_count() == 1:
-                return jax.device_put(rows, sharding)
-            return jax.make_array_from_process_local_data(sharding, rows)
+                return jax.device_put(rows, shd)
+            return jax.make_array_from_process_local_data(shd, rows)
 
         def batch_fn(step: int) -> tuple:
             pi, pc = jax.process_index(), jax.process_count()
@@ -507,9 +527,9 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
                 pos, tg, inputs, w = _mlm_positions_batch(rows, rand)
                 return (
                     to_global(jnp.asarray(inputs, jnp.int32)),
-                    to_global(jnp.asarray(pos, jnp.int32)),
-                    to_global(jnp.asarray(tg, jnp.int32)),
-                    to_global(jnp.asarray(w, jnp.float32)),
+                    to_global(jnp.asarray(pos, jnp.int32), sharding_rows),
+                    to_global(jnp.asarray(tg, jnp.int32), sharding_rows),
+                    to_global(jnp.asarray(w, jnp.float32), sharding_rows),
                 )
             m = rand < 0.15
             inputs = to_global(jnp.asarray(np.where(m, 0, rows), jnp.int32))

@@ -18,7 +18,6 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
 
-from ..ops.attention import attention_reference, flash_attention
 from ..parallel.mesh import FSDP, TP
 
 
@@ -33,7 +32,11 @@ class BertConfig:
     type_vocab_size: int = 2
     norm_eps: float = 1e-12
     dtype: Any = jnp.bfloat16
-    attention_impl: str = "flash"  # or 'dense'
+    # 'flash' (pallas), 'dense' (XLA reference), or the sequence-parallel
+    # strategies over an sp mesh axis for long sequences: 'ring'
+    # (non-causal ppermute ring) / 'ulysses' (two all-to-alls). The
+    # sp strategies need a mesh on the module.
+    attention_impl: str = "flash"
 
 
 def bert_base(**overrides) -> BertConfig:
@@ -50,6 +53,7 @@ def tiny(**overrides) -> BertConfig:
 
 class EncoderLayer(nn.Module):
     config: BertConfig
+    mesh: Any = None  # required for attention_impl='ring'/'ulysses'
 
     @nn.compact
     def __call__(self, x):
@@ -63,10 +67,11 @@ class EncoderLayer(nn.Module):
         q = dense(cfg.dim, "wq")(x).reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
         k = dense(cfg.dim, "wk")(x).reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
         v = dense(cfg.dim, "wv")(x).reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
-        if cfg.attention_impl == "flash":
-            att = flash_attention(q, k, v)
-        else:
-            att = attention_reference(q, k, v)
+        from ..ops.ring_attention import sp_attention
+
+        att = sp_attention(
+            q, k, v, self.mesh, cfg.attention_impl, causal=False
+        )
         att = att.transpose(0, 2, 1, 3).reshape(b, s, cfg.dim)
         x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="attn_norm")(
             x + dense(cfg.dim, "wo")(att)
@@ -80,6 +85,7 @@ class EncoderLayer(nn.Module):
 
 class Bert(nn.Module):
     config: BertConfig
+    mesh: Any = None  # required for attention_impl='ring'/'ulysses'
 
     @nn.compact
     def __call__(self, tokens, token_types=None, mlm_positions=None):
@@ -107,7 +113,7 @@ class Bert(nn.Module):
             )(token_types)
         h = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="embed_norm")(h)
         for i in range(cfg.n_layers):
-            h = EncoderLayer(cfg, name=f"layer_{i}")(h)
+            h = EncoderLayer(cfg, self.mesh, name=f"layer_{i}")(h)
         if mlm_positions is not None:
             h = jnp.take_along_axis(
                 h, mlm_positions[..., None].astype(jnp.int32), axis=1

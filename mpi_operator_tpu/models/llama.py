@@ -27,8 +27,6 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
 
-from ..ops.attention import attention_reference, flash_attention
-from ..ops.ring_attention import ring_attention_shard_mapped
 from ..parallel.mesh import FSDP, SP, TP
 
 
@@ -186,33 +184,16 @@ class Attention(nn.Module):
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
 
-        # [B, H, S, D] layout. flash/ring take GQA-shaped kv natively (the
-        # kernels map query heads onto shared kv heads without expanding
-        # them in HBM); only the dense oracle needs the explicit repeat.
-        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-        if cfg.attention_impl == "dense" and cfg.n_kv_heads != cfg.n_heads:
-            groups = cfg.n_heads // cfg.n_kv_heads
-            k = jnp.repeat(k, groups, axis=1)
-            v = jnp.repeat(v, groups, axis=1)
-        if cfg.attention_impl == "flash":
-            out = flash_attention(q, k, v, causal=True)
-        elif cfg.attention_impl == "ring":
-            if self.mesh is None or SP not in self.mesh.axis_names:
-                raise ValueError("attention_impl='ring' needs a mesh with an sp axis")
-            out = ring_attention_shard_mapped(
-                q, k, v, self.mesh, causal=True,
-                zigzag=_use_zigzag(cfg, self.mesh),
-            )
-        elif cfg.attention_impl == "ulysses":
-            if self.mesh is None or SP not in self.mesh.axis_names:
-                raise ValueError(
-                    "attention_impl='ulysses' needs a mesh with an sp axis"
-                )
-            from ..ops.ulysses import ulysses_attention_shard_mapped
+        # [B, H, S, D] layout. flash/ring/ulysses take GQA-shaped kv
+        # natively; the shared dispatch expands kv only for the dense
+        # oracle. Unknown impl names raise there.
+        from ..ops.ring_attention import sp_attention
 
-            out = ulysses_attention_shard_mapped(q, k, v, self.mesh, causal=True)
-        else:
-            out = attention_reference(q, k, v, causal=True)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        out = sp_attention(
+            q, k, v, self.mesh, cfg.attention_impl, causal=True,
+            zigzag=_use_zigzag(cfg, self.mesh),
+        )
         out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
         return dense(cfg.dim, "wo")(out)
 

@@ -67,6 +67,25 @@ def stack_block_params(params, n_layers: int, n_stages: int):
     )
 
 
+def restack_block_params(blocks, n_stages_new: int):
+    """Re-split stage-stacked block leaves [P, L/P, ...] onto a new pp
+    size [P', L/P', ...] (layer order is pp-invariant, so this is a pure
+    reshape) — the elastic-resume path for pipelined checkpoints."""
+    def re(w):
+        p, per = w.shape[0], w.shape[1]
+        n_layers = p * per
+        if n_layers % n_stages_new:
+            raise ValueError(
+                f"{n_layers} layers not divisible by new pp size "
+                f"{n_stages_new}"
+            )
+        return w.reshape(
+            (n_stages_new, n_layers // n_stages_new) + w.shape[2:]
+        )
+
+    return jax.tree_util.tree_map(re, blocks)
+
+
 def pp_params_from_init(params, cfg: LlamaConfig, n_stages: int):
     """Regroup a standard init into the pipelined layout:
     {embed, blocks (stage-stacked), final_norm, lm_head}."""

@@ -218,6 +218,48 @@ def ring_spec(mesh, axis: str = SP, n_heads: Optional[int] = None):
     return P(batch_axes if batch_axes else None, head_axis, axis, None)
 
 
+def sp_attention(
+    q, k, v,
+    mesh,
+    impl: str,
+    *,
+    causal: bool,
+    zigzag: bool = False,
+):
+    """The single attention dispatch for model code (llama, bert):
+    'flash' (pallas kernel), 'dense' (XLA reference; GQA kv heads are
+    expanded here since the reference has no grouped path), 'ring'
+    (sequence-parallel ppermute ring over sp; honors ``zigzag`` for
+    causal balance), or 'ulysses' (all-to-all sequence parallelism).
+    Unknown names raise — a typo must not silently train the dense
+    path. Operands are [B, H, S, D]."""
+    from .attention import attention_reference, flash_attention
+
+    if impl == "flash":
+        return flash_attention(q, k, v, causal=causal)
+    if impl == "dense":
+        groups = q.shape[1] // k.shape[1]
+        if groups > 1:
+            k = jnp.repeat(k, groups, axis=1)
+            v = jnp.repeat(v, groups, axis=1)
+        return attention_reference(q, k, v, causal=causal)
+    if impl in ("ring", "ulysses"):
+        if mesh is None or SP not in mesh.axis_names:
+            raise ValueError(
+                f"attention_impl={impl!r} needs a mesh with an sp axis"
+            )
+        if impl == "ring":
+            return ring_attention_shard_mapped(
+                q, k, v, mesh, causal=causal, zigzag=zigzag
+            )
+        from .ulysses import ulysses_attention_shard_mapped
+
+        return ulysses_attention_shard_mapped(q, k, v, mesh, causal=causal)
+    raise ValueError(
+        f"unknown attention impl {impl!r}; want flash|dense|ring|ulysses"
+    )
+
+
 def sp_attention_specs(mesh, q_heads: int, kv_heads: int, axis: str = SP):
     """(q_spec, kv_spec) for the [B, H, S, D] operands of either
     sequence-parallel strategy (ring or Ulysses) — the single source of

@@ -162,6 +162,22 @@ class TestPipelinedLlama:
         leaf = jax.tree_util.tree_leaves(p["blocks"])[0]
         assert "fsdp" in str(leaf.sharding.spec)
 
+    def test_restack_preserves_function(self, setup):
+        """Re-splitting a pp=4 checkpoint onto pp=2 computes the same
+        loss — the elastic pipeline-resume path."""
+        cfg, model, params, tokens = setup
+        pp4 = pp_lib.pp_params_from_init(params, cfg, 4)
+        pp2 = dict(pp4)
+        pp2["blocks"] = pp_lib.restack_block_params(pp4["blocks"], 2)
+        mesh = create_mesh(dp=4, pp=2)
+        loss_fn = pp_lib.make_pp_loss_fn(cfg, mesh, microbatch_size=4)
+        with mesh:
+            l_pp2 = float(jax.jit(loss_fn)(pp2, shard_batch(tokens, mesh)))
+        l_plain = float(llama_lib.loss_fn(model, params, tokens))
+        np.testing.assert_allclose(l_plain, l_pp2, rtol=1e-5)
+        with pytest.raises(ValueError, match="not divisible"):
+            pp_lib.restack_block_params(pp4["blocks"], 3)
+
     def test_rejects_moe_and_indivisible_layers(self, setup):
         cfg, *_ = setup
         mesh = create_mesh(dp=2, pp=4)

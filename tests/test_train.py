@@ -67,6 +67,16 @@ class TestTrainModels:
         )
         assert m["final_step"] == 3
 
+    def test_bert_tiny_sequence_parallel(self, capsys):
+        # ring: works at any sp (tiny bert has 2 heads, so ulysses would
+        # need sp <= 2).
+        m = run_train(
+            capsys, "--model", "bert-tiny", "--steps", "3", "--warmup", "1",
+            "--mesh", "dp=2,sp=4", "--sequence-parallel", "ring",
+            "--global-batch", "8", "--seq-len", "32", "--log-every", "0",
+        )
+        assert m["final_step"] == 3
+
     def test_bert_tiny_positions_layout(self, capsys):
         m = run_train(
             capsys, "--model", "bert-tiny", "--steps", "3", "--warmup", "1",

@@ -299,6 +299,41 @@ class TestBert:
             params, opt_state, loss = step(params, opt_state, tokens, pos, tg, w)
         assert float(loss) < float(l0)
 
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_sequence_parallel_matches_dense(self, impl):
+        """Non-causal ring/Ulysses attention in the encoder must compute
+        the dense model's MLM loss on an sp mesh."""
+        from mpi_operator_tpu.parallel import create_mesh, shard_batch
+
+        rng = np.random.RandomState(0)
+        # 4 heads so sp=4 divides them (the Ulysses requirement).
+        cfg_d = bert_lib.tiny(n_heads=4)
+        model_d = bert_lib.Bert(cfg_d)
+        params = bert_lib.init_params(model_d, jax.random.PRNGKey(0),
+                                      batch=2, seq=32)
+        tokens = jnp.asarray(rng.randint(0, cfg_d.vocab_size, (4, 32)),
+                             jnp.int32)
+        mask = jnp.asarray(rng.rand(4, 32) < 0.2, jnp.float32)
+        targets = jnp.asarray(rng.randint(0, cfg_d.vocab_size, (4, 32)),
+                              jnp.int32)
+        want = float(bert_lib.mlm_loss(model_d, params, tokens, mask, targets))
+
+        mesh = create_mesh(dp=2, sp=4)
+        cfg_s = bert_lib.tiny(n_heads=4, attention_impl=impl)
+        model_s = bert_lib.Bert(cfg_s, mesh=mesh)
+        sb = lambda x: shard_batch(x, mesh, sequence_axis=1)
+        with mesh:
+            got = float(jax.jit(
+                lambda p, t, m, tg: bert_lib.mlm_loss(model_s, p, t, m, tg)
+            )(params, sb(tokens), sb(mask), sb(targets)))
+        np.testing.assert_allclose(want, got, rtol=1e-5)
+
+    def test_sp_impl_without_mesh_rejected(self):
+        cfg = bert_lib.tiny(attention_impl="ring")
+        model = bert_lib.Bert(cfg)
+        with pytest.raises(ValueError, match="sp axis"):
+            bert_lib.init_params(model, jax.random.PRNGKey(0))
+
     def test_token_types_change_output(self):
         cfg = bert_lib.tiny()
         model = bert_lib.Bert(cfg)
