@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -453,6 +454,28 @@ SUITES = {
 }
 
 
+def _backend_watchdog(timeout_s: float):
+    """The TPU tunnel in this environment can wedge so hard that backend
+    init blocks forever (no exception, no timeout). Arm a deadman: if
+    the first device isn't visible within ``timeout_s``, print a clear
+    diagnosis and hard-exit non-zero instead of hanging the caller."""
+    import threading
+
+    ready = threading.Event()
+
+    def arm():
+        if not ready.wait(timeout_s):
+            log(
+                f"FATAL: TPU backend did not initialize within "
+                f"{timeout_s:.0f}s — the tunnel is unresponsive; "
+                f"aborting instead of hanging"
+            )
+            os._exit(3)
+
+    threading.Thread(target=arm, daemon=True).start()
+    return ready
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--suite", choices=[*SUITES, "all"], default="resnet")
@@ -472,6 +495,23 @@ def main() -> int:
     parser.add_argument("--perf-md", default="",
                         help="append results as a markdown table row file")
     args = parser.parse_args()
+
+    # Fail fast if the accelerator tunnel is wedged. Env override
+    # BENCH_BACKEND_TIMEOUT_S (seconds; <= 0 disables the watchdog);
+    # the startup suite is CPU-only and skips it.
+    if args.suite != "startup":
+        try:
+            timeout_s = float(os.environ.get("BENCH_BACKEND_TIMEOUT_S", "180"))
+        except ValueError:
+            raise SystemExit(
+                "BENCH_BACKEND_TIMEOUT_S must be a number of seconds"
+            )
+        if timeout_s > 0:
+            ready = _backend_watchdog(timeout_s)
+            import jax
+
+            jax.devices()
+            ready.set()
 
     if args.suite == "all":
         results = {}
