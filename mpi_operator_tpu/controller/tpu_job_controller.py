@@ -20,6 +20,7 @@ from the reference, all TPU-motivated:
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Optional
@@ -43,7 +44,11 @@ from ..api.v2beta1.types import (
     ReplicaStatus,
     TPUJob,
 )
-from ..runtime.apiserver import InMemoryAPIServer, NotFoundError
+from ..runtime.apiserver import (
+    ConflictError,
+    InMemoryAPIServer,
+    NotFoundError,
+)
 from ..runtime.client import KubeClient, SchedulingClient, TPUJobClient
 from ..runtime.informer import EventHandler, InformerFactory, meta_namespace_key, split_key
 from ..runtime.objects import KubeObject
@@ -942,6 +947,28 @@ class TPUJobController:
         self.jobs_failed.inc()
 
     def _do_update_job_status(self, job: TPUJob) -> None:
-        """doUpdateJobStatus :1098-1101 analog (status subresource write)."""
+        """doUpdateJobStatus :1098-1101 analog (status subresource write).
+
+        The job came from the informer cache, whose resourceVersion can
+        trail the apiserver right after our own writes; on Conflict,
+        re-GET the live object, transplant the freshly computed status
+        onto it, and retry once. Safety valve: if a concurrent writer
+        already drove the live status terminal and ours is not, DROP the
+        write instead — a stale-computed status must never resurrect a
+        finished job (the next sync recomputes from fresh state). A
+        second conflict falls through to the workqueue's rate-limited
+        requeue as before."""
         job.status.last_reconcile_time = self.clock()
-        self.tpujobs.tpujobs(job.namespace).update_status(job)
+        client = self.tpujobs.tpujobs(job.namespace)
+        try:
+            client.update_status(job)
+        except ConflictError:
+            live = client.get(job.name)
+            if st.is_finished(live.status) and not st.is_finished(job.status):
+                logging.getLogger(__name__).info(
+                    "dropping stale status write for %s/%s: live status "
+                    "is already terminal", job.namespace, job.name,
+                )
+                return
+            live.status = job.status
+            client.update_status(live)

@@ -595,3 +595,53 @@ class TestTerminalStatusGuards:
         f.controller.factory.pump_until_quiet()
         f.controller.sync_handler("default/test-job")
         assert f.controller.job_info.value("test-job-launcher", "default") == 0
+
+
+class TestStatusUpdateConflict:
+    def test_stale_cache_status_write_retries_on_conflict(self):
+        """A status write from a stale informer-cache copy must re-GET
+        and retry (client-go RetryOnConflict discipline), not bubble a
+        ConflictError into the workqueue's error path."""
+        f = Fixture()
+        job = make_synced_job(f)
+        stale = f.get_job()  # snapshot at current resourceVersion
+        # Someone else updates the job spec behind the cache's back.
+        live = f.api.get("tpujobs", "default", "test-job")
+        live["metadata"]["labels"] = {"touched": "yes"}
+        f.api.update("tpujobs", live)
+
+        stale.status.replica_statuses.setdefault(
+            "Worker", st.ReplicaStatus()
+        ).active = 4
+        # Direct write with the stale rv: must succeed via retry.
+        f.controller._do_update_job_status(stale)
+        after = f.get_job()
+        assert after.status.replica_statuses["Worker"].active == 4
+        # The concurrent label update survived (we transplanted status
+        # onto the LIVE object, not overwrote it).
+        assert f.api.get("tpujobs", "default", "test-job")["metadata"][
+            "labels"
+        ]["touched"] == "yes"
+
+    def test_stale_write_never_resurrects_a_finished_job(self):
+        """If a concurrent writer drove the live job terminal, a stale
+        non-terminal status write is dropped, not retried over it."""
+        f = Fixture()
+        job = make_synced_job(f)
+        stale = f.get_job()
+        # A concurrent controller marks the job Failed (terminal).
+        live = f.get_job()
+        st.update_job_conditions(
+            live, st.JOB_FAILED, "BackoffLimitExceeded", "boom", now=NOW
+        )
+        f.controller._do_update_job_status(live)
+        # The stale writer tries to write a Running-ish status.
+        stale.status.replica_statuses.setdefault(
+            "Worker", st.ReplicaStatus()
+        ).active = 4
+        f.controller._do_update_job_status(stale)
+        after = f.get_job()
+        assert st.is_failed(after.status)
+        assert after.status.replica_statuses.get("Worker") is None or (
+            after.status.replica_statuses["Worker"].active != 4
+        )
