@@ -278,8 +278,6 @@ class TPUJobController:
             self.sync_handler(key)
         except Exception as e:  # transient: requeue with backoff (:430)
             self.queue.add_rate_limited(key)
-            import logging
-
             logging.getLogger(__name__).warning("error syncing %r: %s", key, e)
         else:
             self.queue.forget(key)
