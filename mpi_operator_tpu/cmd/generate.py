@@ -1,0 +1,99 @@
+"""Decode from a trainer checkpoint — the inference CLI.
+
+The training CLI (cmd.train) writes orbax checkpoints whose state is
+``{"params": ..., "opt_state": ...}``; this tool reads the newest one
+and runs KV-cache autoregressive decoding (models/generate.py) on it.
+Together they close the loop the reference leaves entirely to user
+images: train on the operator, decode from the artifact.
+
+    python -m mpi_operator_tpu.cmd.generate \
+        --checkpoint-dir /ckpt/llama --model llama-tiny \
+        --prompt 12,7,42 --max-new 16 [--temperature 0.8 --seed 1]
+
+Prints one JSON line: {"prompt": [...], "tokens": [...], "new": [...]}.
+Token IDs in/out — tokenizers are corpus-specific and out of scope, the
+same boundary the data loader draws (data/loader.py reads pre-tokenized
+uint32 streams).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpujob-generate",
+        description="KV-cache decoding from a cmd.train checkpoint",
+    )
+    p.add_argument("--checkpoint-dir", required=True)
+    p.add_argument("--model", default="llama-tiny",
+                   help="llama3-8b|llama-tiny|mixtral-8x7b|llama-moe-tiny "
+                        "(must match the training run)")
+    p.add_argument("--prompt", required=True,
+                   help="comma-separated token ids, e.g. 12,7,42")
+    p.add_argument("--max-new", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy; > 0 = softmax sampling")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        prompt_ids = [int(t) for t in args.prompt.split(",") if t.strip()]
+    except ValueError:
+        raise SystemExit("--prompt must be comma-separated integer token ids")
+    if not prompt_ids:
+        raise SystemExit("--prompt must contain at least one token id")
+    if args.max_new < 1:
+        raise SystemExit("--max-new must be >= 1")
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import llama as llama_lib
+    from ..models.generate import generate
+    from ..utils.checkpoint import CheckpointManager
+
+    try:
+        cfg = llama_lib.config_for(args.model)
+    except KeyError:
+        raise SystemExit(f"unknown --model {args.model!r} (llama family only)")
+    bad = [t for t in prompt_ids if not 0 <= t < cfg.vocab_size]
+    if bad:
+        raise SystemExit(
+            f"prompt ids {bad} outside the model vocab [0, {cfg.vocab_size})"
+        )
+
+    ckpt = CheckpointManager(args.checkpoint_dir)
+    step, state = ckpt.read_latest()
+    if step is None:
+        raise SystemExit(f"no checkpoint found under {args.checkpoint_dir}")
+    if "params" not in state:
+        raise SystemExit(
+            f"checkpoint at step {step} has no 'params' entry — was it "
+            f"written by cmd.train?"
+        )
+
+    prompt = jnp.asarray([prompt_ids], jnp.int32)
+    rng = jax.random.PRNGKey(args.seed) if args.temperature > 0 else None
+    out = generate(
+        state["params"], prompt, cfg,
+        max_new=args.max_new, temperature=args.temperature, rng=rng,
+    )
+    tokens = [int(t) for t in out[0]]
+    print(json.dumps({
+        "step": step,
+        "prompt": prompt_ids,
+        "tokens": tokens,
+        "new": tokens[len(prompt_ids):],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

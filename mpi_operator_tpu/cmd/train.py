@@ -224,13 +224,8 @@ def llama_config_from_args(args, sp: int):
         remat_policy=args.remat_policy,
         xent_chunk=args.xent_chunk,
     )
-    if args.model == "llama3-8b":
-        return lib.llama3_8b(**kw)
-    if args.model == "mixtral-8x7b":
-        return lib.mixtral_8x7b(**kw)
-    if args.model == "llama-moe-tiny":
-        return lib.tiny_moe(**kw)
-    return lib.tiny(**kw)
+    name = args.model if args.model in lib.CONFIGS else "llama-tiny"
+    return lib.config_for(name, **kw)
 
 
 def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):

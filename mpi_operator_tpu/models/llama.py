@@ -120,6 +120,24 @@ def tiny_moe(**overrides) -> LlamaConfig:
     return tiny(**merged)
 
 
+# The one name->config mapping both CLIs (cmd.train, cmd.generate) use —
+# a checkpoint trained under a name must always be loadable under it.
+CONFIGS = {
+    "llama3-8b": llama3_8b,
+    "llama-tiny": tiny,
+    "mixtral-8x7b": mixtral_8x7b,
+    "llama-moe-tiny": tiny_moe,
+}
+
+
+def config_for(name: str, **overrides) -> LlamaConfig:
+    if name not in CONFIGS:
+        raise KeyError(
+            f"unknown llama model {name!r}; want one of {sorted(CONFIGS)}"
+        )
+    return CONFIGS[name](**overrides)
+
+
 def _rope(x, positions, theta: float):
     """Rotary embeddings. x: [B, S, H, D_head]; positions: [B, S]."""
     d = x.shape[-1]

@@ -618,6 +618,12 @@ class TestStatusUpdateConflict:
         f.controller._do_update_job_status(stale)
         after = f.get_job()
         assert after.status.replica_statuses["Worker"].active == 4
+        # The retry must go through the STATUS subresource of the live
+        # object: a regression to a full-object update(stale) would
+        # clobber the concurrent label write below.
+        assert f.api.get("tpujobs", "default", "test-job")["metadata"][
+            "labels"
+        ]["touched"] == "yes"
 
     def test_stale_write_never_resurrects_a_finished_job(self):
         """If a concurrent writer drove the live job terminal, a stale

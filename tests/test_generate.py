@@ -125,6 +125,52 @@ class TestGenerate:
         k, v = caches[0]
         assert k.shape == (3, cfg.n_kv_heads, 10, cfg.head_dim)
 
+    def test_cli_decodes_from_train_checkpoint(self, capsys, tmp_path):
+        """cmd.train -> orbax checkpoint -> cmd.generate, end to end."""
+        import json as _json
+
+        from mpi_operator_tpu.cmd import generate as gen_cmd
+        from tests.test_train import run_train
+
+        ckpt = str(tmp_path / "ckpt")
+        run_train(
+            capsys, "--model", "llama-tiny", "--steps", "2", "--warmup", "1",
+            "--global-batch", "8", "--seq-len", "16", "--log-every", "0",
+            "--checkpoint-dir", ckpt, "--save-every", "1",
+        )
+        rc = gen_cmd.main([
+            "--checkpoint-dir", ckpt, "--model", "llama-tiny",
+            "--prompt", "12,7,42", "--max-new", "5",
+        ])
+        assert rc == 0
+        out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["step"] == 2
+        assert out["tokens"][:3] == [12, 7, 42]
+        assert len(out["new"]) == 5
+
+    def test_cli_rejects_bad_prompt_and_missing_ckpt(self, tmp_path):
+        from mpi_operator_tpu.cmd import generate as gen_cmd
+
+        with pytest.raises(SystemExit, match="integer token ids"):
+            gen_cmd.main([
+                "--checkpoint-dir", str(tmp_path), "--prompt", "a,b",
+            ])
+        with pytest.raises(SystemExit, match="vocab"):
+            gen_cmd.main([
+                "--checkpoint-dir", str(tmp_path), "--model", "llama-tiny",
+                "--prompt", "99999",
+            ])
+        with pytest.raises(SystemExit, match="no checkpoint"):
+            gen_cmd.main([
+                "--checkpoint-dir", str(tmp_path / "empty"),
+                "--model", "llama-tiny", "--prompt", "1,2",
+            ])
+        with pytest.raises(SystemExit, match="max-new"):
+            gen_cmd.main([
+                "--checkpoint-dir", str(tmp_path), "--model", "llama-tiny",
+                "--prompt", "1,2", "--max-new", "0",
+            ])
+
     def test_tied_embeddings(self):
         cfg = llama_lib.tiny(tie_embeddings=True)
         model = llama_lib.Llama(cfg)
