@@ -28,7 +28,32 @@ import time
 
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 154.2  # reference per-GPU steady state
 BASELINE_E2E_BOUND_S = 200.0  # reference pi-job Succeeded bound
-V5E_BF16_PEAK_TFLOPS = 197.0  # per-chip peak, for MFU readouts
+# Per-chip bf16 peaks for honest MFU readouts, keyed by substrings of
+# jax Device.device_kind; v5e is the fallback (this environment's chip).
+BF16_PEAK_TFLOPS = {
+    # Order matters: first substring match wins, and libtpu reports v5e
+    # as "TPU v5 lite" but v5p as plain "TPU v5" — the lite keys must
+    # come before the bare "v5" (v5p) catch-all.
+    "v5 lite": 197.0,   # v5e
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v5": 459.0,        # "TPU v5" = v5p
+    "v6 lite": 918.0,   # v6e / Trillium
+    "v6e": 918.0,
+    "v4": 275.0,
+}
+V5E_BF16_PEAK_TFLOPS = 197.0
+
+
+def peak_tflops() -> tuple[float, str]:
+    """(bf16 peak TFLOP/s, label) for the first visible device."""
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for key, peak in BF16_PEAK_TFLOPS.items():
+        if key in kind.lower():
+            return peak, kind
+    return V5E_BF16_PEAK_TFLOPS, f"{kind} (assumed v5e peak)"
 
 
 def log(*args):
@@ -203,10 +228,11 @@ def bench_resnet(args) -> dict:
     per_chip = global_batch / sec / n
     flops = 3 * resnet_lib.flops_per_image(args.depth, args.image_size)
     tflops = flops * per_chip / 1e12
+    peak, kind = peak_tflops()
     log(
         f"{per_chip * n:.1f} images/sec total, {per_chip:.1f}/chip, "
         f"{sec * 1000:.1f} ms/step, ~{tflops:.2f} TFLOP/s/chip "
-        f"(~{100 * tflops / V5E_BF16_PEAK_TFLOPS:.1f}% of v5e bf16 peak)"
+        f"(~{100 * tflops / peak:.1f}% of {kind} bf16 peak)"
     )
     return {
         "metric": f"resnet{args.depth}_images_per_sec_per_chip",
@@ -288,17 +314,18 @@ def bench_bert(args) -> dict:
         + 6 * n_head * n_pred
     )
     tflops = flops_seq * batch / sec / n / 1e12
+    peak, kind = peak_tflops()
     log(
         f"bert-base: {seqs_per_sec:.1f} seq/s/chip, {sec * 1000:.1f} ms/step, "
         f"loss {float(loss):.3f}, ~{tflops:.1f} TFLOP/s/chip "
-        f"(~{100 * tflops / V5E_BF16_PEAK_TFLOPS:.1f}% of v5e bf16 peak)"
+        f"(~{100 * tflops / peak:.1f}% of {kind} bf16 peak)"
     )
     return {
         "metric": "bert_base_mlm_sequences_per_sec_per_chip",
         "value": round(seqs_per_sec, 2),
         "unit": f"seq({seq_len})/sec/chip",
         # No reference transformer baseline exists; report MFU fraction.
-        "vs_baseline": round(tflops / V5E_BF16_PEAK_TFLOPS, 3),
+        "vs_baseline": round(tflops / peak, 3),
     }
 
 
@@ -363,17 +390,18 @@ def bench_llama(args) -> dict:
     # Causal attention: half the score matrix is masked → 6·L·d·s.
     flops_tok = 6 * n_params + 6 * cfg.n_layers * cfg.dim * seq_len
     tflops = flops_tok * tokens_per_sec / 1e12
+    peak, kind = peak_tflops()
     log(
         f"llama-{n_params / 1e6:.0f}M: {tokens_per_sec:.0f} tok/s/chip, "
         f"{sec * 1000:.1f} ms/step, loss {float(loss):.3f}, "
         f"~{tflops:.1f} TFLOP/s/chip "
-        f"(~{100 * tflops / V5E_BF16_PEAK_TFLOPS:.1f}% of v5e bf16 peak)"
+        f"(~{100 * tflops / peak:.1f}% of {kind} bf16 peak)"
     )
     return {
         "metric": "llama_0p7b_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": f"tokens({seq_len})/sec/chip",
-        "vs_baseline": round(tflops / V5E_BF16_PEAK_TFLOPS, 3),
+        "vs_baseline": round(tflops / peak, 3),
     }
 
 
