@@ -387,8 +387,15 @@ def bench_llama(args) -> dict:
         )
 
     tokens_per_sec = batch * seq_len / sec / n
+    # PaLM-style MFU: the 6N term counts matmul params only, so drop the
+    # input-embedding table (a gather, not a matmul). With untied
+    # embeddings n_params also holds the lm_head kernel — keep it, that
+    # projection is a real matmul; when tied, the single table IS the
+    # head matmul and stays.
+    embed_params = 0 if cfg.tie_embeddings else cfg.vocab_size * cfg.dim
     # Causal attention: half the score matrix is masked → 6·L·d·s.
-    flops_tok = 6 * n_params + 6 * cfg.n_layers * cfg.dim * seq_len
+    flops_tok = (6 * (n_params - embed_params)
+                 + 6 * cfg.n_layers * cfg.dim * seq_len)
     tflops = flops_tok * tokens_per_sec / 1e12
     peak, kind = peak_tflops()
     log(

@@ -68,6 +68,14 @@ def main(argv=None) -> int:
         raise SystemExit(
             f"prompt ids {bad} outside the model vocab [0, {cfg.vocab_size})"
         )
+    total = len(prompt_ids) + args.max_new
+    if total > cfg.max_seq_len:
+        # RoPE extrapolates silently past the training window; refuse —
+        # and do it before paying the checkpoint load.
+        raise SystemExit(
+            f"prompt ({len(prompt_ids)}) + --max-new ({args.max_new}) = "
+            f"{total} exceeds the model context {cfg.max_seq_len}"
+        )
 
     ckpt = CheckpointManager(args.checkpoint_dir)
     step, state = ckpt.read_latest()
@@ -78,11 +86,28 @@ def main(argv=None) -> int:
             f"checkpoint at step {step} has no 'params' entry — was it "
             f"written by cmd.train?"
         )
+    params = state["params"]
+    if "blocks" in params:
+        # A pp-mesh training run stores the stage-stacked layout
+        # {embed, blocks [P, L/P, ...], final_norm[, lm_head]}; unstack
+        # it into the layer_i form generate() walks rather than failing
+        # deep in the decode step with a KeyError.
+        from ..models.llama_pp import unstack_block_params
+
+        blocks = unstack_block_params(params["blocks"])
+        n_found = len(blocks)
+        if n_found != cfg.n_layers:
+            raise SystemExit(
+                f"pipelined checkpoint holds {n_found} layers but "
+                f"{args.model} has {cfg.n_layers} — wrong --model?"
+            )
+        params = {k: v for k, v in params.items() if k != "blocks"}
+        params.update(blocks)
 
     prompt = jnp.asarray([prompt_ids], jnp.int32)
     rng = jax.random.PRNGKey(args.seed) if args.temperature > 0 else None
     out = generate(
-        state["params"], prompt, cfg,
+        params, prompt, cfg,
         max_new=args.max_new, temperature=args.temperature, rng=rng,
     )
     tokens = [int(t) for t in out[0]]

@@ -25,6 +25,7 @@ assembles exactly its rows; resume reproduces the stream bit-exactly).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import logging
 import sys
@@ -224,8 +225,14 @@ def llama_config_from_args(args, sp: int):
         remat_policy=args.remat_policy,
         xent_chunk=args.xent_chunk,
     )
-    name = args.model if args.model in lib.CONFIGS else "llama-tiny"
-    return lib.config_for(name, **kw)
+    if args.model not in lib.CONFIGS:
+        # Mirror cmd.generate: an unrecognized name (e.g. the typo
+        # "llama3_8b") must not silently train llama-tiny.
+        raise SystemExit(
+            f"unknown --model {args.model!r}; choose from "
+            f"{sorted(lib.CONFIGS)} or a bert-*/resnet* name"
+        )
+    return lib.config_for(args.model, **kw)
 
 
 def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
@@ -398,10 +405,22 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
         attention = {} if sp == 1 else {
             "attention_impl": args.sequence_parallel
         }
-        cfg = (
-            lib.bert_base(**attention) if args.model == "bert-base"
-            else lib.tiny(**attention)
-        )
+        if args.model not in ("bert-base", "bert-tiny"):
+            # Same rule as the llama arm: a typo ("bert-large",
+            # "bert-tinny") must not silently train the toy config.
+            raise SystemExit(
+                f"unknown --model {args.model!r}; bert models are "
+                f"bert-base or bert-tiny"
+            )
+        builder = lib.bert_base if args.model == "bert-base" else lib.tiny
+        cfg = builder(**attention)
+        if args.seq_len > cfg.max_seq_len:
+            # Long-sequence runs (the whole point of ring/Ulysses sp)
+            # legitimately exceed the stock window; grow the learned
+            # position table to fit.  Without this the arange(s) lookup
+            # would clamp and silently reuse the last embedding for
+            # every position past max_seq_len.
+            cfg = dataclasses.replace(cfg, max_seq_len=args.seq_len)
         model = lib.Bert(cfg, mesh=mesh)
         with mesh:
             # Init shapes must satisfy the mesh: sp attention traces a

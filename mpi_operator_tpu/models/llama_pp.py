@@ -67,6 +67,22 @@ def stack_block_params(params, n_layers: int, n_stages: int):
     )
 
 
+def unstack_block_params(blocks):
+    """Invert ``stack_block_params``: stage-stacked leaves [P, L/P, ...]
+    back into ``{layer_i: ...}`` subtrees (layer order is pp-invariant).
+    Lets non-pipelined consumers — decoding, a resume onto a pp=1 mesh —
+    use a pipelined checkpoint directly."""
+    flat = jax.tree_util.tree_map(
+        lambda w: w.reshape((w.shape[0] * w.shape[1],) + w.shape[2:]), blocks
+    )
+    leaves, _ = jax.tree_util.tree_flatten(flat)
+    n_layers = leaves[0].shape[0]
+    return {
+        f"layer_{i}": jax.tree_util.tree_map(lambda w: w[i], flat)
+        for i in range(n_layers)
+    }
+
+
 def restack_block_params(blocks, n_stages_new: int):
     """Re-split stage-stacked block leaves [P, L/P, ...] onto a new pp
     size [P', L/P', ...] (layer order is pp-invariant, so this is a pure

@@ -171,6 +171,68 @@ class TestGenerate:
                 "--prompt", "1,2", "--max-new", "0",
             ])
 
+    def test_cli_decodes_from_pipelined_checkpoint(self, capsys, tmp_path):
+        """A pp-mesh training run stores stage-stacked {'blocks': ...}
+        params; the CLI must unstack them and decode identically to the
+        layer_i layout rather than dying on KeyError 'layer_0'."""
+        import json as _json
+
+        from mpi_operator_tpu.cmd import generate as gen_cmd
+        from mpi_operator_tpu.models.llama_pp import pp_params_from_init
+        from mpi_operator_tpu.utils.checkpoint import CheckpointManager
+
+        cfg = llama_lib.tiny()
+        model = llama_lib.Llama(cfg)
+        params = llama_lib.init_params(model, jax.random.PRNGKey(0))
+        pp_params = pp_params_from_init(params, cfg, n_stages=cfg.n_layers)
+        ckpt = CheckpointManager(str(tmp_path / "ppckpt"))
+        ckpt.save(3, {"params": pp_params}, force=True)
+        ckpt.close()
+
+        rc = gen_cmd.main([
+            "--checkpoint-dir", str(tmp_path / "ppckpt"),
+            "--model", "llama-tiny", "--prompt", "5,11", "--max-new", "4",
+        ])
+        assert rc == 0
+        out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        want = generate(
+            params, jnp.asarray([[5, 11]], jnp.int32), cfg, max_new=4
+        )
+        assert out["tokens"] == [int(t) for t in want[0]]
+
+    def test_cli_rejects_overlong_decode_and_wrong_pp_model(self, tmp_path):
+        """prompt+max_new past the context window and a pipelined
+        checkpoint whose depth mismatches --model both fail clearly."""
+        from mpi_operator_tpu.cmd import generate as gen_cmd
+        from mpi_operator_tpu.models.llama_pp import pp_params_from_init
+        from mpi_operator_tpu.utils.checkpoint import CheckpointManager
+
+        cfg = llama_lib.tiny()  # max_seq_len is small for tiny
+        model = llama_lib.Llama(cfg)
+        params = llama_lib.init_params(model, jax.random.PRNGKey(0))
+        ckpt = CheckpointManager(str(tmp_path / "c"))
+        ckpt.save(1, {"params": params}, force=True)
+        ckpt.close()
+        with pytest.raises(SystemExit, match="exceeds the model context"):
+            gen_cmd.main([
+                "--checkpoint-dir", str(tmp_path / "c"),
+                "--model", "llama-tiny", "--prompt", "1,2",
+                "--max-new", str(cfg.max_seq_len),
+            ])
+
+        deep = llama_lib.tiny(n_layers=4)
+        dmodel = llama_lib.Llama(deep)
+        dparams = llama_lib.init_params(dmodel, jax.random.PRNGKey(1))
+        pp_params = pp_params_from_init(dparams, deep, n_stages=2)
+        ckpt2 = CheckpointManager(str(tmp_path / "d"))
+        ckpt2.save(1, {"params": pp_params}, force=True)
+        ckpt2.close()
+        with pytest.raises(SystemExit, match="wrong --model"):
+            gen_cmd.main([
+                "--checkpoint-dir", str(tmp_path / "d"),
+                "--model", "llama-tiny", "--prompt", "1", "--max-new", "2",
+            ])
+
     def test_tied_embeddings(self):
         cfg = llama_lib.tiny(tie_embeddings=True)
         model = llama_lib.Llama(cfg)

@@ -67,6 +67,28 @@ class TestTrainModels:
         )
         assert m["final_step"] == 3
 
+    def test_unknown_model_rejected(self):
+        """A typo like 'llama3_8b' must not silently train llama-tiny
+        (cmd.generate rejects unknown names; train must agree)."""
+        with pytest.raises(SystemExit, match="unknown --model"):
+            train_cmd.main([
+                "--model", "llama3_8b", "--steps", "1", "--log-every", "0",
+            ])
+        with pytest.raises(SystemExit, match="unknown --model"):
+            train_cmd.main([
+                "--model", "bert-large", "--steps", "1", "--log-every", "0",
+            ])
+
+    def test_bert_seq_len_grows_position_table(self, capsys):
+        """--seq-len past the config's max_seq_len (tiny: 64) must grow
+        the learned position table, not clamp the lookup so every
+        position past the window reuses the last embedding."""
+        m = run_train(
+            capsys, "--model", "bert-tiny", "--steps", "2", "--warmup", "1",
+            "--global-batch", "8", "--seq-len", "96", "--log-every", "0",
+        )
+        assert m["final_step"] == 2
+
     def test_bert_tiny_sequence_parallel(self, capsys):
         # ring: works at any sp (tiny bert has 2 heads, so ulysses would
         # need sp <= 2).
