@@ -73,6 +73,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "~/.kube/config, then in-cluster config)")
     p.add_argument("--kube-context", default="",
                    help="kubeconfig context to use (default: current-context)")
+    p.add_argument("--kube-api-qps", type=float, default=5.0,
+                   help="maximum sustained QPS to the apiserver from this "
+                        "client (0 disables throttling)")
+    p.add_argument("--kube-api-burst", type=int, default=10,
+                   help="maximum burst for apiserver client throttle")
     p.add_argument("--apply", action="append", default=[],
                    help="TPUJob YAML file(s) to apply at startup")
     p.add_argument("--exit-on-completion", action="store_true",
@@ -152,7 +157,10 @@ def build_backend(args):
         config = load_config(args.kubeconfig or None,
                              args.kube_context or None)
         print(f"connecting to apiserver {config.host}")
-        return KubeAPIServer(config, user_agent=f"tpu-operator/{_ua()}"), None
+        return KubeAPIServer(
+            config, user_agent=f"tpu-operator/{_ua()}",
+            qps=args.kube_api_qps, burst=args.kube_api_burst,
+        ), None
     api = InMemoryAPIServer()
     return api, LocalPodRunner(api)
 

@@ -25,6 +25,7 @@ Faithful bits:
 
 from __future__ import annotations
 
+import base64
 import json
 import re
 import threading
@@ -61,6 +62,15 @@ class APIServerFrontend:
         self.api = api
         self.token = token
         self.history_limit = history_limit
+        # Fault/behavior knobs for client-hardening tests:
+        # throttle_429 > 0: the next N non-watch requests get 429 with a
+        # Retry-After header (apiserver priority-and-fairness shedding).
+        self.throttle_429 = 0
+        self.throttle_hits = 0
+        # expire_continue: every list continuation token 410s (etcd
+        # compacted the snapshot) — clients must restart the list.
+        self.expire_continue = False
+        self._knob_lock = threading.Lock()
         # Watch cache: rv-ordered (rv, WatchEvent) history per resource,
         # fed by one persistent watch per resource.
         self._history: dict[str, list[tuple[int, WatchEvent]]] = {
@@ -179,6 +189,29 @@ class _Handler(BaseHTTPRequestHandler):
     def _send_api_error(self, err: ApiError) -> None:
         self._send_status_error(err.code, err.reason, str(err))
 
+    def _throttled(self) -> bool:
+        """429 shedding knob: consume one slot if armed (watches exempt —
+        the real server's APF treats long-running requests separately)."""
+        fe = self.frontend
+        with fe._knob_lock:
+            if fe.throttle_429 <= 0:
+                return False
+            fe.throttle_429 -= 1
+            fe.throttle_hits += 1
+        self.send_response(429)
+        body = json.dumps({
+            "apiVersion": "v1", "kind": "Status", "status": "Failure",
+            "code": 429, "reason": "TooManyRequests",
+            "message": "the server is currently unable to handle the "
+                       "request — try again later",
+        }).encode()
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Retry-After", "0")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        return True
+
     def _authorized(self) -> bool:
         token = self.frontend.token
         if token is None:
@@ -234,28 +267,76 @@ class _Handler(BaseHTTPRequestHandler):
         plural, ns, name, _sub, query = route
         api = self.frontend.api
         try:
+            if query.get("watch") == "true" and not name:
+                self._watch(plural, ns, query)
+                return
+            if self._throttled():
+                return
             if name:
                 self._send_json(200, api.get(plural, ns or "default", name))
-            elif query.get("watch") == "true":
-                self._watch(plural, ns, query)
             else:
-                items = api.list(plural, ns, self._parse_selector(query))
-                rt = RESOURCES[plural]
-                # Collection rv: the newest rv across the store (next()-1
-                # would race writers; max over items is the same contract
-                # the real watch cache provides — "at least this fresh").
-                rv = max(
-                    (int(o["metadata"]["resourceVersion"]) for o in items),
-                    default=self._newest_known_rv(),
-                )
-                self._send_json(200, {
-                    "apiVersion": rt.api_version,
-                    "kind": rt.kind + "List",
-                    "metadata": {"resourceVersion": str(rv)},
-                    "items": items,
-                })
+                self._list(plural, ns, query)
         except ApiError as e:
             self._send_api_error(e)
+
+    def _list(self, plural: str, ns: Optional[str], query: dict) -> None:
+        """List with ``limit``/``continue`` chunking (apiserver
+        pagination). The continue token encodes the last-returned key;
+        ``expire_continue`` makes every continuation 410 to exercise the
+        client's restart path."""
+        items = self.frontend.api.list(plural, ns, self._parse_selector(query))
+        items.sort(key=lambda o: (o["metadata"].get("namespace", ""),
+                                  o["metadata"]["name"]))
+        cont = query.get("continue")
+        if cont:
+            if self.frontend.expire_continue:
+                self._send_status_error(
+                    410, "Expired",
+                    "the provided continue parameter is too old",
+                )
+                return
+            try:
+                after = tuple(json.loads(base64.b64decode(cont)))
+            except (ValueError, TypeError):
+                after = None
+            if (after is None or len(after) != 2
+                    or not all(isinstance(p, str) for p in after)):
+                self._send_status_error(
+                    400, "BadRequest", "malformed continue token"
+                )
+                return
+            items = [
+                o for o in items
+                if (o["metadata"].get("namespace", ""),
+                    o["metadata"]["name"]) > after
+            ]
+        rt = RESOURCES[plural]
+        # Collection rv: the newest rv across the store (next()-1
+        # would race writers; max over items is the same contract
+        # the real watch cache provides — "at least this fresh").
+        rv = max(
+            (int(o["metadata"]["resourceVersion"]) for o in items),
+            default=self._newest_known_rv(),
+        )
+        meta: dict = {"resourceVersion": str(rv)}
+        try:
+            limit = int(query.get("limit") or 0)
+        except ValueError:
+            limit = 0
+        if limit and len(items) > limit:
+            last = items[limit - 1]
+            meta["remainingItemCount"] = len(items) - limit
+            items = items[:limit]
+            meta["continue"] = base64.b64encode(json.dumps([
+                last["metadata"].get("namespace", ""),
+                last["metadata"]["name"],
+            ]).encode()).decode()
+        self._send_json(200, {
+            "apiVersion": rt.api_version,
+            "kind": rt.kind + "List",
+            "metadata": meta,
+            "items": items,
+        })
 
     def _newest_known_rv(self) -> int:
         newest = 0
@@ -266,7 +347,7 @@ class _Handler(BaseHTTPRequestHandler):
         return newest
 
     def do_POST(self):  # noqa: N802
-        if not self._authorized():
+        if not self._authorized() or self._throttled():
             return
         route = self._route()
         if route is None:
@@ -287,7 +368,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_status_error(400, "BadRequest", str(e))
 
     def do_PUT(self):  # noqa: N802
-        if not self._authorized():
+        if not self._authorized() or self._throttled():
             return
         route = self._route()
         if route is None or not route[2]:
@@ -311,7 +392,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_status_error(400, "BadRequest", str(e))
 
     def do_DELETE(self):  # noqa: N802
-        if not self._authorized():
+        if not self._authorized() or self._throttled():
             return
         route = self._route()
         if route is None or not route[2]:

@@ -36,6 +36,7 @@ import base64
 import json
 import logging
 import os
+import random
 import ssl
 import tempfile
 import threading
@@ -83,6 +84,13 @@ class ServerError(ApiError):
     reason = "InternalError"
 
 
+class TooManyRequestsError(ApiError):
+    """429 after the client's retry budget is exhausted."""
+
+    code = 429
+    reason = "TooManyRequests"
+
+
 _ERRORS_BY_REASON = {
     "NotFound": NotFoundError,
     "AlreadyExists": AlreadyExistsError,
@@ -97,7 +105,39 @@ _ERRORS_BY_CODE = {
     422: InvalidError,
     403: ForbiddenError,
     401: UnauthorizedError,
+    429: TooManyRequestsError,
 }
+
+
+class _TokenBucket:
+    """Client-side flow control (client-go's TokenBucketRateLimiter analog,
+    rest.Config QPS/Burst — reference options.go:69-70). ``qps <= 0``
+    disables throttling. Callers over the rate queue fairly: the bucket
+    balance goes negative and each further caller's wait grows by 1/qps."""
+
+    def __init__(self, qps: float, burst: int):
+        self.qps = float(qps)
+        self.burst = max(1, int(burst))
+        self._balance = float(self.burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def acquire(self) -> float:
+        """Take one token, sleeping until it is due; returns the wait."""
+        if self.qps <= 0:
+            return 0.0
+        with self._lock:
+            now = time.monotonic()
+            self._balance = min(
+                float(self.burst),
+                self._balance + (now - self._last) * self.qps,
+            )
+            self._last = now
+            self._balance -= 1.0
+            wait = 0.0 if self._balance >= 0 else -self._balance / self.qps
+        if wait > 0:
+            time.sleep(wait)
+        return wait
 
 
 # ---------------------------------------------------------------------------
@@ -427,10 +467,27 @@ class KubeAPIServer:
     """``InMemoryAPIServer``-surface client for a real kube-apiserver."""
 
     def __init__(self, config: RestConfig, *, user_agent: str = "tpu-operator",
-                 request_timeout: float = 30.0):
+                 request_timeout: float = 30.0, qps: float = 0.0,
+                 burst: int = 10, page_limit: int = 500,
+                 max_retries: int = 5):
         self.config = config
         self.user_agent = user_agent
         self.request_timeout = request_timeout
+        # Client-side throttle (off by default; the operator CLI wires
+        # --kube-api-qps/--kube-api-burst, reference defaults 5/10).
+        self._limiter = _TokenBucket(qps, burst)
+        # Lists arrive in pages of this many items (0 = unpaginated) —
+        # the Reflector's WatchListPageSize discipline.
+        self.page_limit = page_limit
+        self.max_retries = max_retries
+        # Total time one logical request may spend across retry sleeps —
+        # keeps a Retry-After storm from silently stretching a single
+        # call past lease-renewal deadlines (leader election calls sit
+        # on this same client).
+        self.max_retry_duration = 30.0
+        # Observability for tests: requests that were retried/throttled.
+        self.retry_count = 0
+        self.throttle_wait = 0.0
         parsed = urllib.parse.urlsplit(config.host)
         if parsed.scheme not in ("http", "https"):
             raise ValueError(f"unsupported apiserver scheme {parsed.scheme!r}")
@@ -481,6 +538,19 @@ class KubeAPIServer:
         err.code = code
         return err
 
+    def _retry_delay(self, attempt: int,
+                     retry_after: Optional[str]) -> float:
+        """Server-directed Retry-After wins; else jittered exponential
+        backoff (0.25s·2^n, capped, 50-100% jitter so a fleet of clients
+        does not re-stampede in lockstep)."""
+        if retry_after:
+            try:
+                return max(0.0, min(float(retry_after), 30.0))
+            except ValueError:
+                pass
+        base = min(0.25 * (2 ** attempt), 8.0)
+        return base * (0.5 + random.random() / 2)
+
     def _request(self, method: str, path: str, *, resource: str = "",
                  name: str = "", query: Optional[dict] = None,
                  body: Optional[dict] = None,
@@ -491,34 +561,64 @@ class KubeAPIServer:
                 {k: v for k, v in query.items() if v is not None}
             )
         payload = None
-        headers = self._headers()
         if body is not None:
             payload = json.dumps(body).encode()
-            headers["Content-Type"] = "application/json"
-        conn = self._connect()
-        try:
-            conn.request(method, url, body=payload, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
-            if resp.status == 401 and _retry_auth:
-                # Expired rotating credential: re-acquire and retry once.
-                if self.config.refresh_token():
-                    conn.close()
-                    return self._request(
-                        method, path, resource=resource, name=name,
-                        query=query, body=body, _retry_auth=False,
+        attempt = 0
+        retry_deadline = time.monotonic() + self.max_retry_duration
+        while True:
+            self.throttle_wait += self._limiter.acquire()
+            headers = self._headers()
+            if payload is not None:
+                headers["Content-Type"] = "application/json"
+            conn = self._connect()
+            try:
+                conn.request(method, url, body=payload, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.status == 401 and _retry_auth:
+                    # Expired rotating credential: re-acquire, retry once
+                    # (does not consume the transient-failure budget).
+                    if self.config.refresh_token():
+                        _retry_auth = False
+                        continue
+                if resp.status < 300:
+                    return json.loads(data) if data else {}
+                # 429 means the server never processed the request, so
+                # every verb retries; transient gateway 5xx retry only
+                # for GET (the idempotent verb — a replayed PUT/POST
+                # could double-apply behind a flaky LB).
+                transient = (
+                    resp.status == 429
+                    or (method == "GET"
+                        and resp.status in (500, 502, 503, 504))
+                )
+                if transient and attempt < self.max_retries:
+                    delay = self._retry_delay(
+                        attempt, resp.getheader("Retry-After")
                     )
-            if resp.status >= 300:
+                    if time.monotonic() + delay <= retry_deadline:
+                        attempt += 1
+                        self.retry_count += 1
+                        time.sleep(delay)
+                        continue
                 raise self._error_from_response(
                     resource, name, resp.status, data
                 )
-            return json.loads(data) if data else {}
-        except ApiError:
-            raise
-        except (OSError, ValueError) as e:
-            raise ServerError(resource, name, f"{method} {url}: {e}") from e
-        finally:
-            conn.close()
+            except ApiError:
+                raise
+            except (OSError, ValueError) as e:
+                if method == "GET" and attempt < self.max_retries:
+                    delay = self._retry_delay(attempt, None)
+                    if time.monotonic() + delay <= retry_deadline:
+                        attempt += 1
+                        self.retry_count += 1
+                        time.sleep(delay)
+                        continue
+                raise ServerError(
+                    resource, name, f"{method} {url}: {e}"
+                ) from e
+            finally:
+                conn.close()
 
     @staticmethod
     def _ns_name(obj: dict) -> tuple[str, str]:
@@ -561,17 +661,53 @@ class KubeAPIServer:
         self, resource: str, namespace: Optional[str] = None,
         label_selector: Optional[dict] = None,
     ) -> tuple[list[dict], str]:
-        """List plus the collection resourceVersion (watch baseline)."""
-        result = self._request(
-            "GET", resource_path(resource, namespace),
-            resource=resource,
-            query={"labelSelector": _selector_query(label_selector)},
+        """List plus the collection resourceVersion (watch baseline).
+
+        Pages through the collection ``page_limit`` items at a time
+        (``limit``/``continue``, the Reflector's chunked-list
+        discipline) so a large cluster never forces one giant response.
+        An expired continue token (410 mid-pagination) restarts the
+        whole list — pages from different snapshots must not be mixed.
+        """
+        sel = _selector_query(label_selector)
+        path = resource_path(resource, namespace)
+        for _restart in range(4):
+            items: list[dict] = []
+            rv = ""
+            cont: Optional[str] = None
+            while True:
+                query = {
+                    "labelSelector": sel,
+                    "limit": str(self.page_limit) if self.page_limit else None,
+                    "continue": cont,
+                }
+                try:
+                    result = self._request(
+                        "GET", path, resource=resource, query=query,
+                    )
+                except ApiError as e:
+                    if cont is not None and getattr(e, "code", 0) == 410:
+                        break  # token expired: restart from page one
+                    raise
+                items += [
+                    self._stamp(resource, o)
+                    for o in result.get("items") or []
+                ]
+                meta = result.get("metadata") or {}
+                # Every page is served from the same snapshot; the first
+                # page's rv is the collection rv.
+                rv = rv or meta.get("resourceVersion", "")
+                cont = meta.get("continue") or None
+                if cont is None:
+                    items.sort(
+                        key=lambda o: (o["metadata"].get("namespace", ""),
+                                       o["metadata"]["name"])
+                    )
+                    return items, rv
+        raise ServerError(
+            resource, "", "list pagination restarted 4x on expired "
+            "continue tokens without completing",
         )
-        items = [self._stamp(resource, o) for o in result.get("items") or []]
-        items.sort(key=lambda o: (o["metadata"].get("namespace", ""),
-                                  o["metadata"]["name"]))
-        rv = (result.get("metadata") or {}).get("resourceVersion", "")
-        return items, rv
 
     def update(self, resource: str, obj: dict) -> dict:
         ns, name = self._ns_name(obj)
@@ -732,6 +868,9 @@ class KubeWatch:
         url = (self._server._base_path
                + resource_path(self.resource, self.namespace)
                + "?" + urllib.parse.urlencode(query))
+        # Watch opens ride the same client-side throttle as CRUD calls
+        # (a relist storm must not bypass --kube-api-qps).
+        self._server.throttle_wait += self._server._limiter.acquire()
         conn = self._server._connect(timeout=330.0)
         conn.request("GET", url, headers=self._server._headers())
         resp = conn.getresponse()

@@ -297,6 +297,112 @@ class TestWatchOverHttp:
             fe.stop()
 
 
+class TestRealApiserverBehaviors:
+    """Wire-level behaviors a production apiserver exhibits — chunked
+    lists, 429 shedding, compacted continue tokens — emulated by the
+    frontend so the client's handling is actually exercised
+    (reference integration tier: v2/test/integration/main_test.go:42-59)."""
+
+    def test_list_paginates_with_limit_continue(self, frontend):
+        kube = KubeAPIServer(RestConfig(host=frontend.url), page_limit=3)
+        try:
+            for i in range(10):
+                kube.create("pods", pod(f"p{i:02d}"))
+            # Count the actual pages and their sizes so the test fails
+            # if either side quietly stops chunking.
+            pages = []
+            orig = kube._request
+
+            def counting(method, path, **kw):
+                result = orig(method, path, **kw)
+                if method == "GET" and "items" in result:
+                    pages.append(len(result["items"]))
+                return result
+
+            kube._request = counting
+            names = [p["metadata"]["name"] for p in kube.list("pods")]
+            assert names == [f"p{i:02d}" for i in range(10)]
+            assert pages == [3, 3, 3, 1]
+            # Unpaginated mode really is one full response.
+            pages.clear()
+            kube.page_limit = 0
+            assert len(kube.list("pods")) == 10
+            assert pages == [10]
+        finally:
+            kube.close()
+
+    def test_expired_continue_restarts_list(self, frontend):
+        kube = KubeAPIServer(RestConfig(host=frontend.url), page_limit=2)
+        try:
+            for i in range(5):
+                kube.create("pods", pod(f"p{i}"))
+            # Every continuation 410s; the client must restart from page
+            # one — and once the expiry clears (first restart), complete.
+            frontend.expire_continue = True
+
+            orig = kube._request
+            calls = {"n": 0}
+
+            def flaky(method, path, **kw):
+                # Clear the fault after the client hits the first 410 so
+                # the restarted list can finish.
+                if frontend.expire_continue and calls["n"] > 1:
+                    frontend.expire_continue = False
+                calls["n"] += 1
+                return orig(method, path, **kw)
+
+            kube._request = flaky
+            names = [p["metadata"]["name"] for p in kube.list("pods")]
+            assert names == [f"p{i}" for i in range(5)]
+        finally:
+            kube.close()
+
+    def test_429_retries_honor_retry_after(self, frontend, kube):
+        kube.create("pods", pod("p1"))
+        frontend.throttle_429 = 2  # next two requests shed
+        got = kube.get("pods", "default", "p1")
+        assert got["metadata"]["name"] == "p1"
+        assert frontend.throttle_hits == 2
+        assert kube.retry_count >= 2
+
+    def test_429_budget_exhausted_raises(self, frontend, kube):
+        from mpi_operator_tpu.runtime.kube import TooManyRequestsError
+
+        kube.max_retries = 1
+        frontend.throttle_429 = 10
+        with pytest.raises(TooManyRequestsError):
+            kube.get("pods", "default", "whatever")
+        frontend.throttle_429 = 0
+
+    def test_429_retries_writes_too(self, frontend, kube):
+        # 429 = the server never processed the request, so even POST
+        # retries (unlike transient 5xx, which only GET retries).
+        frontend.throttle_429 = 1
+        created = kube.create("pods", pod("w1"))
+        assert created["metadata"]["name"] == "w1"
+        assert frontend.throttle_hits == 1
+
+    def test_token_bucket_paces_requests(self, frontend):
+        kube = KubeAPIServer(
+            RestConfig(host=frontend.url), qps=20.0, burst=1,
+        )
+        try:
+            t0 = time.monotonic()
+            for i in range(5):
+                kube.create("pods", pod(f"b{i}"))
+            elapsed = time.monotonic() - t0
+            # burst 1 free + 4 paced at 20 QPS => >= 200ms wall-clock
+            # (minus whatever the HTTP round-trips themselves burn).
+            assert elapsed >= 0.15, elapsed
+            assert kube.throttle_wait > 0.0
+        finally:
+            kube.close()
+
+    def test_token_bucket_off_by_default(self, kube, frontend):
+        kube.create("pods", pod("fast"))
+        assert kube.throttle_wait == 0.0
+
+
 class TestKubeconfig:
     def test_parse_token_and_inline_ca(self, tmp_path):
         import base64
