@@ -97,6 +97,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="compute the LM head + cross-entropy this many "
                         "sequence positions at a time (llama; 0 = full "
                         "[B,S,V] logits)")
+    p.add_argument("--n-layers", type=int, default=0,
+                   help="override the llama config's layer count (0 = "
+                        "config default) — pipeline-depth experiments and "
+                        "pp-resize tests without a bespoke config")
     p.add_argument("--grad-accum", type=int, default=1,
                    help="accumulate gradients over N sequential "
                         "microbatches per optimizer step (LM models; "
@@ -225,6 +229,8 @@ def llama_config_from_args(args, sp: int):
         remat_policy=args.remat_policy,
         xent_chunk=args.xent_chunk,
     )
+    if args.n_layers:
+        kw["n_layers"] = args.n_layers
     if args.model not in lib.CONFIGS:
         # Mirror cmd.generate: an unrecognized name (e.g. the typo
         # "llama3_8b") must not silently train llama-tiny.
@@ -319,8 +325,8 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
     params = pp_lib.shard_pp_params(
         pp_lib.pp_params_from_init(params0, cfg, pp), mesh
     )
-    # jit init so mu/nu inherit the params' shardings via GSPMD.
-    opt_state = jax.jit(optimizer.init)(params)
+    # Moments shard like the stage-stacked blocks; counters replicate.
+    opt_state = pp_lib.shard_pp_opt_state(optimizer.init(params), mesh)
 
     tokens = shard_batch(
         jnp.asarray(

@@ -138,6 +138,26 @@ def shard_pp_params(pp_params, mesh):
     return {**rest, "blocks": blocks}
 
 
+def shard_pp_opt_state(opt_state, mesh):
+    """Place optimizer-state leaves for the pipelined layout. Moment
+    leaves mirroring the stage-stacked blocks (ndim >= 3 — every block
+    leaf is [P, L/P, d, ...]; embed/norm/head are at most 2-D) shard
+    like the blocks; everything else, including step counters,
+    replicates over the WHOLE mesh. Explicit placement matters: leaving
+    init outputs committed to one device makes later jits reject the
+    mixed device sets — and gives checkpoint resume a wrong template."""
+    fsdp = _fsdp_size(mesh) > 1
+    repl = NamedSharding(mesh, P())
+
+    def place(w):
+        if getattr(w, "ndim", 0) >= 3:
+            spec = _block_leaf_spec(w) if fsdp else P(PP)
+            return jax.device_put(w, NamedSharding(mesh, spec))
+        return jax.device_put(w, repl)
+
+    return jax.tree_util.tree_map(place, opt_state)
+
+
 def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
     """Next-token CE with the blocks pipelined over pp. Params must be in
     the ``pp_params_from_init`` layout. Honors ``cfg.xent_chunk`` and

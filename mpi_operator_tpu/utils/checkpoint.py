@@ -23,6 +23,52 @@ from typing import Any, Optional
 log = logging.getLogger(__name__)
 
 
+def _shapes_by_path(meta_tree: Any) -> dict[tuple, tuple]:
+    """Flatten an orbax metadata tree (dicts/lists after the namedtuple
+    erasure) into {path-of-names: stored shape}."""
+    out: dict[tuple, tuple] = {}
+
+    def rec(node, path):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                rec(v, path + (str(k),))
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                rec(v, path + (str(i),))
+        elif node is not None:
+            shape = getattr(node, "shape", None)
+            if shape is not None:
+                out[path] = tuple(shape)
+
+    rec(meta_tree, ())
+    return out
+
+
+def _map_with_path(fn, tree: Any, path: tuple = ()) -> Any:
+    """Rebuild ``tree`` with ``fn(leaf, path)`` at each leaf, naming
+    paths the way orbax metadata does: dict keys as-is, namedtuple
+    FIELD NAMES (not indices), sequence indices as strings."""
+    if isinstance(tree, dict):
+        return type(tree)(
+            (k, _map_with_path(fn, v, path + (str(k),)))
+            for k, v in tree.items()
+        )
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):  # namedtuple
+        return type(tree)(*(
+            _map_with_path(fn, v, path + (f,))
+            for f, v in zip(tree._fields, tree)
+        ))
+    if isinstance(tree, (list, tuple)):
+        mapped = [
+            _map_with_path(fn, v, path + (str(i),))
+            for i, v in enumerate(tree)
+        ]
+        return mapped if isinstance(tree, list) else tuple(mapped)
+    if tree is None:
+        return None
+    return fn(tree, path)
+
+
 class CheckpointManager:
     """save-every-N / keep-K / resume-latest, orbax-backed."""
 
@@ -39,6 +85,10 @@ class CheckpointManager:
         self.directory = directory
         self._mgr = ocp.CheckpointManager(
             directory,
+            # Registering the handler up front lets a FRESH manager read
+            # item_metadata (stored shapes) before any restore — the
+            # restack-on-resume path inspects shapes first.
+            item_handlers=ocp.StandardCheckpointHandler(),
             options=ocp.CheckpointManagerOptions(
                 save_interval_steps=save_interval_steps,
                 max_to_keep=max_to_keep,
@@ -65,15 +115,112 @@ class CheckpointManager:
         """Restore the newest checkpoint shaped/sharded like ``like``
         (the freshly-initialized state on the *current* mesh — this is
         what makes resume-after-elastic-resize work). Returns
-        ``(step, state)`` or ``(None, like)`` when no checkpoint exists."""
+        ``(step, state)`` or ``(None, like)`` when no checkpoint exists.
+
+        Pipelined-elastic resume: when a stored leaf differs from
+        ``like`` only by a re-split of its two leading dims — the
+        stage-stacked ``[P, L/P, ...]`` layout of models/llama_pp.py
+        saved at a different pp size (layer order is pp-invariant) —
+        the leaf is restored at its stored shape and reshaped onto the
+        new stage split, then placed with ``like``'s sharding. A
+        preempted slice rarely comes back the same shape; without this
+        a resume onto a resized pipeline died on a shape mismatch.
+        """
         step = self._mgr.latest_step()
         if step is None:
             return None, like
+        try:
+            template, n_restacked = self._restack_template(step, like)
+        except Exception as e:  # exotic container types: restore strict
+            log.warning("restack template build failed (%s); restoring "
+                        "shape-strict — a pp-resized resume will fail on "
+                        "a shape mismatch", e)
+            template, n_restacked = like, 0
         state = self._mgr.restore(
-            step, args=self._ocp.args.StandardRestore(like)
+            step, args=self._ocp.args.StandardRestore(template)
         )
+        if n_restacked:
+            state = self._reshape_like(state, like)
+            log.info("restacked %d pipeline leaves onto the new pp split",
+                     n_restacked)
         log.info("resumed from checkpoint step %d (%s)", step, self.directory)
         return step, state
+
+    def _restack_template(self, step: int, like: Any) -> tuple[Any, int]:
+        """Build the restore template: ``like``, except leaves whose
+        stored shape is a re-split of the leading (stage, layer) dims
+        become abstract arrays at the STORED shape (replicated — they
+        are re-split and re-sharded after the read).
+
+        The stored shapes come from orbax item metadata, which
+        represents namedtuples (optax states) as plain dicts keyed by
+        field name — so matching walks both trees by PATH NAME, not by
+        pytree structure."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        try:
+            meta = self._mgr.item_metadata(step)
+            meta = getattr(meta, "tree", meta)
+            stored_shapes = _shapes_by_path(meta)
+        except Exception as e:  # metadata layout varies across versions
+            log.warning("no item metadata for step %d (%s); restoring "
+                        "shape-strict — a pp-resized resume will fail on "
+                        "a shape mismatch", step, e)
+            return like, 0
+        if not stored_shapes:
+            return like, 0
+
+        restacked = [0]
+
+        def plan(leaf, path):
+            stored = stored_shapes.get(path)
+            want = tuple(getattr(leaf, "shape", None) or ())
+            if stored is None or stored == want:
+                return leaf
+            # Block leaves are always ndim >= 3 ([P, L/P, d, ...]); a 2-D
+            # leaf with an equal element count is a refactor (e.g. a
+            # transposed kernel), which must keep failing loudly.
+            if (len(stored) == len(want) and len(stored) >= 3
+                    and stored[0] * stored[1] == want[0] * want[1]
+                    and stored[2:] == want[2:]):
+                restacked[0] += 1
+                sharding = None
+                sh = getattr(leaf, "sharding", None)
+                if isinstance(sh, NamedSharding):
+                    # Keep the read sharded: trailing (weight) dims are
+                    # pp-invariant, so ``like``'s spec from dim 2 on
+                    # (e.g. the ZeRO-3 fsdp split) applies to the stored
+                    # shape too; only the re-split leading dims restore
+                    # unsharded.
+                    tail = tuple(sh.spec)[2:]
+                    sharding = NamedSharding(
+                        sh.mesh, PartitionSpec(None, None, *tail)
+                    )
+                return jax.ShapeDtypeStruct(
+                    stored, leaf.dtype, sharding=sharding
+                )
+            return leaf  # genuine mismatch: let orbax raise its error
+
+        return _map_with_path(plan, like), restacked[0]
+
+    @staticmethod
+    def _reshape_like(state: Any, like: Any) -> Any:
+        """Re-split restored ``[P', L/P', ...]`` leaves onto ``like``'s
+        ``[P, L/P, ...]`` stage split (a pure reshape — layer order does
+        not depend on the pp size) and place them with ``like``'s
+        sharding."""
+        import jax
+        import jax.numpy as jnp
+
+        def fix(s, l):
+            if tuple(s.shape) == tuple(l.shape):
+                return s
+            s = jnp.reshape(s, l.shape)
+            sharding = getattr(l, "sharding", None)
+            return jax.device_put(s, sharding) if sharding is not None else s
+
+        return jax.tree_util.tree_map(fix, state, like)
 
     def read_latest(self) -> tuple[Optional[int], Any]:
         """Inspection/tooling path: read the newest checkpoint as plain
