@@ -9,13 +9,16 @@ admission (api/schema.py) the way a real apiserver enforces the CRD —
 malformed pod templates are rejected at create time, not at pod-creation
 time.
 
-The pod template schema is a *trimmed but structural* subset of core/v1:
-every field the operator's builders consume plus the common pod surface
-(containers, env, resources, volumes, scheduling). Exotic subtrees
-(probes, securityContext, affinity, volume sources) stay open via
-``x-kubernetes-preserve-unknown-fields`` — present and typed as objects,
-contents unvalidated, exactly how a trimmed controller-gen schema would
-mark them.
+The pod template schema is a *structural* subset of core/v1: every
+field the operator's builders consume plus the common pod surface —
+containers (env valueFrom/envFrom, probes, lifecycle, securityContext),
+volumes with their typed source union, affinity/topology-spread
+scheduling. Only genuinely unbounded maps (volumeAttributes,
+nodeSelector, labels) stay as additionalProperties string maps; nothing
+under ``containers`` is ``x-kubernetes-preserve-unknown-fields``
+anymore — malformed probes and volume sources are rejected at
+admission, matching the reference's full controller-gen schema
+(v2/crd/kubeflow.org_mpijobs.yaml).
 """
 
 from __future__ import annotations
@@ -56,13 +59,6 @@ def _str_array() -> dict:
     return {"type": "array", "items": {"type": "string"}}
 
 
-def _open_object(desc: str = "") -> dict:
-    d = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
-    if desc:
-        d["description"] = desc
-    return d
-
-
 def _string_map(desc: str = "") -> dict:
     d = {"type": "object", "additionalProperties": {"type": "string"}}
     if desc:
@@ -80,6 +76,736 @@ def quantity_map(desc: str = "") -> dict:
     if desc:
         d["description"] = desc
     return d
+
+
+def _int_or_string(desc: str = "") -> dict:
+    d: dict = {"x-kubernetes-int-or-string": True}
+    if desc:
+        d["description"] = desc
+    return d
+
+
+def _int64(desc: str = "", minimum=None) -> dict:
+    d: dict = {"type": "integer", "format": "int64"}
+    if desc:
+        d["description"] = desc
+    if minimum is not None:
+        d["minimum"] = minimum
+    return d
+
+
+def _name_optional_ref(desc: str = "") -> dict:
+    """LocalObjectReference + optional (configMapRef/secretRef shape)."""
+    d = {
+        "type": "object",
+        "properties": {"name": _str(), "optional": _bool()},
+    }
+    if desc:
+        d["description"] = desc
+    return d
+
+
+def _key_selector(desc: str) -> dict:
+    """configMapKeyRef / secretKeyRef: one key of a named object."""
+    return {
+        "type": "object",
+        "description": desc,
+        "required": ["key"],
+        "properties": {
+            "key": _str(),
+            "name": _str(),
+            "optional": _bool(),
+        },
+    }
+
+
+def env_value_from_schema() -> dict:
+    return {
+        "type": "object",
+        "description": "Source for the env var's value (exactly one).",
+        "properties": {
+            "fieldRef": {
+                "type": "object",
+                "required": ["fieldPath"],
+                "properties": {
+                    "apiVersion": _str(),
+                    "fieldPath": _str("Pod field path, e.g. status.podIP."),
+                },
+            },
+            "resourceFieldRef": {
+                "type": "object",
+                "required": ["resource"],
+                "properties": {
+                    "containerName": _str(),
+                    "divisor": _int_or_string(),
+                    "resource": _str(),
+                },
+            },
+            "configMapKeyRef": _key_selector("A key of a ConfigMap."),
+            "secretKeyRef": _key_selector("A key of a Secret."),
+        },
+    }
+
+
+def env_from_source_schema() -> dict:
+    return {
+        "type": "object",
+        "description": "Bulk env import from a ConfigMap or Secret.",
+        "properties": {
+            "prefix": _str("Prepended to every imported key."),
+            "configMapRef": _name_optional_ref(),
+            "secretRef": _name_optional_ref(),
+        },
+    }
+
+
+def _probe_handler_properties() -> dict:
+    """The action union shared by probes and lifecycle hooks."""
+    return {
+        "exec": {
+            "type": "object",
+            "properties": {"command": _str_array()},
+        },
+        "httpGet": {
+            "type": "object",
+            "required": ["port"],
+            "properties": {
+                "host": _str(),
+                "httpHeaders": {
+                    "type": "array",
+                    "items": {
+                        "type": "object",
+                        "required": ["name", "value"],
+                        "properties": {
+                            "name": _str(),
+                            "value": _str(),
+                        },
+                    },
+                },
+                "path": _str(),
+                "port": _int_or_string(),
+                "scheme": _str(enum=["HTTP", "HTTPS"]),
+            },
+        },
+        "tcpSocket": {
+            "type": "object",
+            "required": ["port"],
+            "properties": {
+                "host": _str(),
+                "port": _int_or_string(),
+            },
+        },
+    }
+
+
+def probe_schema(desc: str) -> dict:
+    return {
+        "type": "object",
+        "description": desc,
+        "properties": {
+            **_probe_handler_properties(),
+            "grpc": {
+                "type": "object",
+                "required": ["port"],
+                "properties": {
+                    "port": _int(minimum=1, maximum=65535),
+                    "service": _str(),
+                },
+            },
+            "initialDelaySeconds": _int(),
+            "periodSeconds": _int(),
+            "timeoutSeconds": _int(),
+            "successThreshold": _int(),
+            "failureThreshold": _int(),
+            "terminationGracePeriodSeconds": _int64(minimum=1),
+        },
+    }
+
+
+def lifecycle_schema() -> dict:
+    handler = {
+        "type": "object",
+        "properties": {
+            **_probe_handler_properties(),
+            "sleep": {
+                "type": "object",
+                "required": ["seconds"],
+                "properties": {"seconds": _int64()},
+            },
+        },
+    }
+    return {
+        "type": "object",
+        "description": "postStart/preStop hooks.",
+        "properties": {"postStart": handler, "preStop": handler},
+    }
+
+
+def _se_linux_options() -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "level": _str(), "role": _str(),
+            "type": _str(), "user": _str(),
+        },
+    }
+
+
+def _typed_profile() -> dict:
+    """seccompProfile and appArmorProfile share this exact shape."""
+    return {
+        "type": "object",
+        "required": ["type"],
+        "properties": {
+            "localhostProfile": _str(),
+            "type": _str(enum=["Localhost", "RuntimeDefault",
+                               "Unconfined"]),
+        },
+    }
+
+
+_seccomp_profile = _typed_profile
+_app_armor_profile = _typed_profile
+
+
+def _windows_options() -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "gmsaCredentialSpec": _str(),
+            "gmsaCredentialSpecName": _str(),
+            "hostProcess": _bool(),
+            "runAsUserName": _str(),
+        },
+    }
+
+
+def container_security_context_schema() -> dict:
+    return {
+        "type": "object",
+        "description": "Container-level security attributes.",
+        "properties": {
+            "allowPrivilegeEscalation": _bool(),
+            "appArmorProfile": _app_armor_profile(),
+            "capabilities": {
+                "type": "object",
+                "properties": {
+                    "add": _str_array(),
+                    "drop": _str_array(),
+                },
+            },
+            "privileged": _bool(),
+            "procMount": _str(),
+            "readOnlyRootFilesystem": _bool(),
+            "runAsGroup": _int64(),
+            "runAsNonRoot": _bool(),
+            "runAsUser": _int64(),
+            "seLinuxOptions": _se_linux_options(),
+            "seccompProfile": _seccomp_profile(),
+            "windowsOptions": _windows_options(),
+        },
+    }
+
+
+def pod_security_context_schema() -> dict:
+    return {
+        "type": "object",
+        "description": "Pod-level security attributes.",
+        "properties": {
+            "appArmorProfile": _app_armor_profile(),
+            "fsGroup": _int64(),
+            "fsGroupChangePolicy": _str(
+                enum=["Always", "OnRootMismatch"]
+            ),
+            "runAsGroup": _int64(),
+            "runAsNonRoot": _bool(),
+            "runAsUser": _int64(),
+            "seLinuxOptions": _se_linux_options(),
+            "seccompProfile": _seccomp_profile(),
+            "supplementalGroups": {
+                "type": "array",
+                "items": _int64(),
+            },
+            "sysctls": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "required": ["name", "value"],
+                    "properties": {"name": _str(), "value": _str()},
+                },
+            },
+            "windowsOptions": _windows_options(),
+        },
+    }
+
+
+def label_selector_schema() -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "matchLabels": _string_map(),
+            "matchExpressions": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "required": ["key", "operator"],
+                    "properties": {
+                        "key": _str(),
+                        "operator": _str(
+                            enum=["In", "NotIn", "Exists", "DoesNotExist"]
+                        ),
+                        "values": _str_array(),
+                    },
+                },
+            },
+        },
+    }
+
+
+def _node_selector_term() -> dict:
+    requirement = {
+        "type": "object",
+        "required": ["key", "operator"],
+        "properties": {
+            "key": _str(),
+            "operator": _str(
+                enum=["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"]
+            ),
+            "values": _str_array(),
+        },
+    }
+    return {
+        "type": "object",
+        "properties": {
+            "matchExpressions": {"type": "array", "items": requirement},
+            "matchFields": {"type": "array", "items": requirement},
+        },
+    }
+
+
+def _pod_affinity_term() -> dict:
+    return {
+        "type": "object",
+        "required": ["topologyKey"],
+        "properties": {
+            "labelSelector": label_selector_schema(),
+            "matchLabelKeys": _str_array(),
+            "mismatchLabelKeys": _str_array(),
+            "namespaceSelector": label_selector_schema(),
+            "namespaces": _str_array(),
+            "topologyKey": _str(),
+        },
+    }
+
+
+def _pod_affinity_group() -> dict:
+    """podAffinity / podAntiAffinity share this shape."""
+    return {
+        "type": "object",
+        "properties": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "type": "array",
+                "items": _pod_affinity_term(),
+            },
+            "preferredDuringSchedulingIgnoredDuringExecution": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "required": ["podAffinityTerm", "weight"],
+                    "properties": {
+                        "podAffinityTerm": _pod_affinity_term(),
+                        "weight": _int(minimum=1, maximum=100),
+                    },
+                },
+            },
+        },
+    }
+
+
+def affinity_schema() -> dict:
+    return {
+        "type": "object",
+        "description": "node/pod (anti-)affinity scheduling constraints.",
+        "properties": {
+            "nodeAffinity": {
+                "type": "object",
+                "properties": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "type": "object",
+                        "required": ["nodeSelectorTerms"],
+                        "properties": {
+                            "nodeSelectorTerms": {
+                                "type": "array",
+                                "items": _node_selector_term(),
+                            },
+                        },
+                    },
+                    "preferredDuringSchedulingIgnoredDuringExecution": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["preference", "weight"],
+                            "properties": {
+                                "preference": _node_selector_term(),
+                                "weight": _int(minimum=1, maximum=100),
+                            },
+                        },
+                    },
+                },
+            },
+            "podAffinity": _pod_affinity_group(),
+            "podAntiAffinity": _pod_affinity_group(),
+        },
+    }
+
+
+def topology_spread_constraint_schema() -> dict:
+    return {
+        "type": "object",
+        "required": ["maxSkew", "topologyKey", "whenUnsatisfiable"],
+        "properties": {
+            "labelSelector": label_selector_schema(),
+            "matchLabelKeys": _str_array(),
+            "maxSkew": _int(minimum=1),
+            "minDomains": _int(minimum=0),
+            "nodeAffinityPolicy": _str(enum=["Honor", "Ignore"]),
+            "nodeTaintsPolicy": _str(enum=["Honor", "Ignore"]),
+            "topologyKey": _str(),
+            "whenUnsatisfiable": _str(
+                enum=["DoNotSchedule", "ScheduleAnyway"]
+            ),
+        },
+    }
+
+
+def _key_path_items() -> dict:
+    """configMap/secret volume item projections."""
+    return {
+        "type": "array",
+        "items": {
+            "type": "object",
+            "required": ["key", "path"],
+            "properties": {
+                "key": _str(),
+                "mode": _int(),
+                "path": _str(),
+            },
+        },
+    }
+
+
+def _downward_api_items() -> dict:
+    return {
+        "type": "array",
+        "items": {
+            "type": "object",
+            "required": ["path"],
+            "properties": {
+                "fieldRef": {
+                    "type": "object",
+                    "required": ["fieldPath"],
+                    "properties": {
+                        "apiVersion": _str(),
+                        "fieldPath": _str(),
+                    },
+                },
+                "mode": _int(),
+                "path": _str(),
+                "resourceFieldRef": {
+                    "type": "object",
+                    "required": ["resource"],
+                    "properties": {
+                        "containerName": _str(),
+                        "divisor": _int_or_string(),
+                        "resource": _str(),
+                    },
+                },
+            },
+        },
+    }
+
+
+def _obj(required=None, **props) -> dict:
+    """Compact object-schema builder for the legacy volume sources."""
+    d: dict = {"type": "object", "properties": props}
+    if required:
+        d["required"] = list(required)
+    return d
+
+
+def _secret_ref() -> dict:
+    return _obj(name=_str())
+
+
+def _legacy_volume_sources() -> dict:
+    """The remaining core/v1 volume sources. Mostly superseded by CSI,
+    but prune semantics mean an OMITTED source would be silently
+    stripped from stored objects (not rejected) — so every core/v1
+    member must stay representable, like the reference's full
+    controller-gen schema."""
+    return {
+        "awsElasticBlockStore": _obj(
+            ["volumeID"], fsType=_str(), partition=_int(),
+            readOnly=_bool(), volumeID=_str(),
+        ),
+        "azureDisk": _obj(
+            ["diskName", "diskURI"], cachingMode=_str(), diskName=_str(),
+            diskURI=_str(), fsType=_str(), kind=_str(), readOnly=_bool(),
+        ),
+        "azureFile": _obj(
+            ["secretName", "shareName"], readOnly=_bool(),
+            secretName=_str(), shareName=_str(),
+        ),
+        "cephfs": _obj(
+            ["monitors"], monitors=_str_array(), path=_str(),
+            readOnly=_bool(), secretFile=_str(), secretRef=_secret_ref(),
+            user=_str(),
+        ),
+        "cinder": _obj(
+            ["volumeID"], fsType=_str(), readOnly=_bool(),
+            secretRef=_secret_ref(), volumeID=_str(),
+        ),
+        "fc": _obj(
+            None, fsType=_str(), lun=_int(), readOnly=_bool(),
+            targetWWNs=_str_array(), wwids=_str_array(),
+        ),
+        "flexVolume": _obj(
+            ["driver"], driver=_str(), fsType=_str(),
+            options=_string_map(), readOnly=_bool(),
+            secretRef=_secret_ref(),
+        ),
+        "flocker": _obj(None, datasetName=_str(), datasetUUID=_str()),
+        "gcePersistentDisk": _obj(
+            ["pdName"], fsType=_str(), partition=_int(), pdName=_str(),
+            readOnly=_bool(),
+        ),
+        "gitRepo": _obj(
+            ["repository"], directory=_str(), repository=_str(),
+            revision=_str(),
+        ),
+        "glusterfs": _obj(
+            ["endpoints", "path"], endpoints=_str(), path=_str(),
+            readOnly=_bool(),
+        ),
+        "image": _obj(None, pullPolicy=_str(), reference=_str()),
+        "iscsi": _obj(
+            ["iqn", "lun", "targetPortal"], chapAuthDiscovery=_bool(),
+            chapAuthSession=_bool(), fsType=_str(), initiatorName=_str(),
+            iqn=_str(), iscsiInterface=_str(), lun=_int(),
+            portals=_str_array(), readOnly=_bool(),
+            secretRef=_secret_ref(), targetPortal=_str(),
+        ),
+        "photonPersistentDisk": _obj(["pdID"], fsType=_str(), pdID=_str()),
+        "portworxVolume": _obj(
+            ["volumeID"], fsType=_str(), readOnly=_bool(), volumeID=_str(),
+        ),
+        "quobyte": _obj(
+            ["registry", "volume"], group=_str(), readOnly=_bool(),
+            registry=_str(), tenant=_str(), user=_str(), volume=_str(),
+        ),
+        "rbd": _obj(
+            ["image", "monitors"], fsType=_str(), image=_str(),
+            keyring=_str(), monitors=_str_array(), pool=_str(),
+            readOnly=_bool(), secretRef=_secret_ref(), user=_str(),
+        ),
+        "scaleIO": _obj(
+            ["gateway", "secretRef", "system"], fsType=_str(),
+            gateway=_str(), protectionDomain=_str(), readOnly=_bool(),
+            secretRef=_secret_ref(), sslEnabled=_bool(),
+            storageMode=_str(), storagePool=_str(), system=_str(),
+            volumeName=_str(),
+        ),
+        "storageos": _obj(
+            None, fsType=_str(), readOnly=_bool(), secretRef=_secret_ref(),
+            volumeName=_str(), volumeNamespace=_str(),
+        ),
+        "vsphereVolume": _obj(
+            ["volumePath"], fsType=_str(), storagePolicyID=_str(),
+            storagePolicyName=_str(), volumePath=_str(),
+        ),
+    }
+
+
+def volume_schema() -> dict:
+    """The complete core/v1 volume-source union, typed. The common TPU
+    sources (datasets, checkpoints, tokens, scratch) are spelled out
+    first; the legacy pre-CSI sources follow so that nothing a user's
+    template legally carries gets pruned away."""
+    return {
+        "type": "object",
+        "required": ["name"],
+        "properties": {
+            "name": _str(pattern=DNS1123),
+            "configMap": {
+                "type": "object",
+                "properties": {
+                    "defaultMode": _int(),
+                    "items": _key_path_items(),
+                    "name": _str(),
+                    "optional": _bool(),
+                },
+            },
+            "secret": {
+                "type": "object",
+                "properties": {
+                    "defaultMode": _int(),
+                    "items": _key_path_items(),
+                    "optional": _bool(),
+                    "secretName": _str(),
+                },
+            },
+            "emptyDir": {
+                "type": "object",
+                "properties": {
+                    "medium": _str(),
+                    "sizeLimit": _int_or_string(),
+                },
+            },
+            "hostPath": {
+                "type": "object",
+                "required": ["path"],
+                "properties": {
+                    "path": _str(),
+                    "type": _str(),
+                },
+            },
+            "persistentVolumeClaim": {
+                "type": "object",
+                "required": ["claimName"],
+                "properties": {
+                    "claimName": _str(),
+                    "readOnly": _bool(),
+                },
+            },
+            "nfs": {
+                "type": "object",
+                "required": ["path", "server"],
+                "properties": {
+                    "path": _str(),
+                    "readOnly": _bool(),
+                    "server": _str(),
+                },
+            },
+            "csi": {
+                "type": "object",
+                "required": ["driver"],
+                "properties": {
+                    "driver": _str(),
+                    "fsType": _str(),
+                    "nodePublishSecretRef": {
+                        "type": "object",
+                        "properties": {"name": _str()},
+                    },
+                    "readOnly": _bool(),
+                    # Driver-defined: a genuinely unbounded string map.
+                    "volumeAttributes": _string_map(),
+                },
+            },
+            "downwardAPI": {
+                "type": "object",
+                "properties": {
+                    "defaultMode": _int(),
+                    "items": _downward_api_items(),
+                },
+            },
+            "projected": {
+                "type": "object",
+                "properties": {
+                    "defaultMode": _int(),
+                    "sources": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "properties": {
+                                "configMap": {
+                                    "type": "object",
+                                    "properties": {
+                                        "items": _key_path_items(),
+                                        "name": _str(),
+                                        "optional": _bool(),
+                                    },
+                                },
+                                "downwardAPI": {
+                                    "type": "object",
+                                    "properties": {
+                                        "items": _downward_api_items(),
+                                    },
+                                },
+                                "secret": {
+                                    "type": "object",
+                                    "properties": {
+                                        "items": _key_path_items(),
+                                        "name": _str(),
+                                        "optional": _bool(),
+                                    },
+                                },
+                                "serviceAccountToken": {
+                                    "type": "object",
+                                    "required": ["path"],
+                                    "properties": {
+                                        "audience": _str(),
+                                        "expirationSeconds": _int64(
+                                            minimum=600
+                                        ),
+                                        "path": _str(),
+                                    },
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+            "ephemeral": {
+                "type": "object",
+                "properties": {
+                    "volumeClaimTemplate": {
+                        "type": "object",
+                        "required": ["spec"],
+                        "properties": {
+                            "metadata": {
+                                "type": "object",
+                                "properties": {
+                                    "labels": _string_map(),
+                                    "annotations": _string_map(),
+                                },
+                            },
+                            "spec": {
+                                "type": "object",
+                                "properties": {
+                                    "accessModes": _str_array(),
+                                    "dataSource": _obj(
+                                        ["kind", "name"],
+                                        apiGroup=_str(), kind=_str(),
+                                        name=_str(),
+                                    ),
+                                    "dataSourceRef": _obj(
+                                        ["kind", "name"],
+                                        apiGroup=_str(), kind=_str(),
+                                        name=_str(), namespace=_str(),
+                                    ),
+                                    "resources": {
+                                        "type": "object",
+                                        "properties": {
+                                            "limits": quantity_map(),
+                                            "requests": quantity_map(),
+                                        },
+                                    },
+                                    "selector": label_selector_schema(),
+                                    "storageClassName": _str(),
+                                    "volumeAttributesClassName": _str(),
+                                    "volumeMode": _str(
+                                        enum=["Block", "Filesystem"]
+                                    ),
+                                    "volumeName": _str(),
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+            **_legacy_volume_sources(),
+        },
+    }
 
 
 def container_schema() -> dict:
@@ -103,15 +829,13 @@ def container_schema() -> dict:
                     "properties": {
                         "name": _str("Environment variable name."),
                         "value": _str(),
-                        "valueFrom": _open_object(
-                            "fieldRef / secretKeyRef / configMapKeyRef source."
-                        ),
+                        "valueFrom": env_value_from_schema(),
                     },
                 },
             },
             "envFrom": {
                 "type": "array",
-                "items": _open_object("configMapRef / secretRef bulk import."),
+                "items": env_from_source_schema(),
             },
             "ports": {
                 "type": "array",
@@ -150,11 +874,11 @@ def container_schema() -> dict:
                     },
                 },
             },
-            "securityContext": _open_object(),
-            "lifecycle": _open_object(),
-            "livenessProbe": _open_object(),
-            "readinessProbe": _open_object(),
-            "startupProbe": _open_object(),
+            "securityContext": container_security_context_schema(),
+            "lifecycle": lifecycle_schema(),
+            "livenessProbe": probe_schema("Container liveness probe."),
+            "readinessProbe": probe_schema("Container readiness probe."),
+            "startupProbe": probe_schema("Container startup probe."),
             "terminationMessagePath": _str(),
             "terminationMessagePolicy": _str(
                 enum=["File", "FallbackToLogsOnError"]
@@ -197,14 +921,7 @@ def pod_template_schema() -> dict:
                     },
                     "volumes": {
                         "type": "array",
-                        "items": {
-                            # name is structural; the volume *source* union
-                            # (30+ types in core/v1) stays open.
-                            "type": "object",
-                            "required": ["name"],
-                            "properties": {"name": _str(pattern=DNS1123)},
-                            "x-kubernetes-preserve-unknown-fields": True,
-                        },
+                        "items": volume_schema(),
                     },
                     "nodeSelector": _string_map(),
                     "tolerations": {
@@ -229,10 +946,10 @@ def pod_template_schema() -> dict:
                             },
                         },
                     },
-                    "affinity": _open_object(),
+                    "affinity": affinity_schema(),
                     "topologySpreadConstraints": {
                         "type": "array",
-                        "items": _open_object(),
+                        "items": topology_spread_constraint_schema(),
                     },
                     "schedulerName": _str(),
                     "priorityClassName": _str(),
@@ -264,7 +981,7 @@ def pod_template_schema() -> dict:
                             "None",
                         ]
                     ),
-                    "securityContext": _open_object(),
+                    "securityContext": pod_security_context_schema(),
                     "imagePullSecrets": {
                         "type": "array",
                         "items": {

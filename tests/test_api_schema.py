@@ -121,6 +121,182 @@ class TestTpujobSchema:
         assert any("does not match" in e for e in errs)
 
 
+class TestTypedPodSubtrees:
+    """The subtrees that used to be x-kubernetes-preserve-unknown-fields
+    (probes, securityContext, affinity, valueFrom, volume sources) are
+    structural now — malformed contents reject at admission, matching
+    the reference's full controller-gen schema."""
+
+    def test_valid_probe_admits(self):
+        tpl = good_template()
+        tpl["spec"]["containers"][0]["readinessProbe"] = {
+            "httpGet": {"path": "/healthz", "port": 8080, "scheme": "HTTP"},
+            "periodSeconds": 5,
+            "failureThreshold": 3,
+        }
+        assert validate_tpujob_object(job_dict(tpl)) == []
+
+    def test_probe_missing_port_rejected(self):
+        tpl = good_template()
+        tpl["spec"]["containers"][0]["livenessProbe"] = {
+            "httpGet": {"path": "/healthz"},
+        }
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any("missing required field 'port'" in e for e in errs)
+
+    def test_probe_bad_scheme_rejected(self):
+        tpl = good_template()
+        tpl["spec"]["containers"][0]["startupProbe"] = {
+            "httpGet": {"port": 1, "scheme": "GOPHER"},
+        }
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any("not one of" in e for e in errs)
+
+    def test_env_value_from_typed(self):
+        tpl = good_template()
+        tpl["spec"]["containers"][0]["env"] = [
+            {"name": "TOKEN",
+             "valueFrom": {"secretKeyRef": {"key": "tok", "name": "s"}}},
+            {"name": "IP",
+             "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}}},
+        ]
+        assert validate_tpujob_object(job_dict(tpl)) == []
+        tpl["spec"]["containers"][0]["env"] = [
+            {"name": "BAD", "valueFrom": {"fieldRef": {}}},
+        ]
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any("missing required field 'fieldPath'" in e for e in errs)
+
+    def test_volume_sources_typed(self):
+        tpl = good_template()
+        tpl["spec"]["volumes"] = [
+            {"name": "ck", "persistentVolumeClaim": {"claimName": "c"}},
+            {"name": "ds", "csi": {"driver": "gcsfuse.csi.storage.gke.io",
+                                   "volumeAttributes": {"bucketName": "b"}}},
+            {"name": "tok", "projected": {"sources": [
+                {"serviceAccountToken": {"path": "token",
+                                         "expirationSeconds": 3600}},
+            ]}},
+        ]
+        assert validate_tpujob_object(job_dict(tpl)) == []
+
+    def test_malformed_volume_rejected(self):
+        tpl = good_template()
+        tpl["spec"]["volumes"] = [{"name": "x", "hostPath": {}}]
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any("missing required field 'path'" in e for e in errs)
+        tpl["spec"]["volumes"] = [{"name": "x", "csi": {}}]
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any("missing required field 'driver'" in e for e in errs)
+        tpl["spec"]["volumes"] = [
+            {"name": "x", "persistentVolumeClaim": {"claimName": 7}}
+        ]
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any("expected string" in e for e in errs)
+
+    def test_affinity_typed(self):
+        tpl = good_template()
+        tpl["spec"]["affinity"] = {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{"matchExpressions": [
+                        {"key": "cloud.google.com/gke-tpu-accelerator",
+                         "operator": "In", "values": ["tpu-v5-lite"]},
+                    ]}],
+                },
+            },
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"topologyKey": "kubernetes.io/hostname"},
+                ],
+            },
+        }
+        assert validate_tpujob_object(job_dict(tpl)) == []
+        tpl["spec"]["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"] = {}
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any(
+            "missing required field 'nodeSelectorTerms'" in e for e in errs
+        )
+
+    def test_affinity_bad_operator_rejected(self):
+        tpl = good_template()
+        tpl["spec"]["affinity"] = {
+            "podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"topologyKey": "zone",
+                     "labelSelector": {"matchExpressions": [
+                         {"key": "app", "operator": "Matches"},
+                     ]}},
+                ],
+            },
+        }
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any("not one of" in e for e in errs)
+
+    def test_topology_spread_typed(self):
+        tpl = good_template()
+        tpl["spec"]["topologySpreadConstraints"] = [
+            {"maxSkew": 1, "topologyKey": "zone",
+             "whenUnsatisfiable": "DoNotSchedule"},
+        ]
+        assert validate_tpujob_object(job_dict(tpl)) == []
+        tpl["spec"]["topologySpreadConstraints"] = [{"topologyKey": "zone"}]
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any("missing required field 'maxSkew'" in e for e in errs)
+
+    def test_security_context_typed(self):
+        tpl = good_template()
+        tpl["spec"]["securityContext"] = {
+            "runAsNonRoot": True, "fsGroup": 1000,
+            "seccompProfile": {"type": "RuntimeDefault"},
+        }
+        tpl["spec"]["containers"][0]["securityContext"] = {
+            "capabilities": {"drop": ["ALL"]},
+            "allowPrivilegeEscalation": False,
+        }
+        assert validate_tpujob_object(job_dict(tpl)) == []
+        tpl["spec"]["securityContext"] = {"seccompProfile": {}}
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any("missing required field 'type'" in e for e in errs)
+
+    def test_legacy_volume_sources_survive_prune(self):
+        """Every core/v1 source must stay representable: prune semantics
+        silently STRIP unknown keys, so an omitted source would turn a
+        working volume into a sourceless one."""
+        tpl = good_template()
+        tpl["spec"]["volumes"] = [
+            {"name": "pd", "gcePersistentDisk": {"pdName": "disk-1"}},
+            {"name": "snap", "ephemeral": {"volumeClaimTemplate": {
+                "spec": {"dataSourceRef": {"kind": "VolumeSnapshot",
+                                           "name": "ckpt-snap"}},
+            }}},
+        ]
+        assert validate_tpujob_object(job_dict(tpl)) == []
+        pruned = prune(tpl, pod_template_schema())
+        assert pruned["spec"]["volumes"][0]["gcePersistentDisk"] == {
+            "pdName": "disk-1"
+        }
+        ref = pruned["spec"]["volumes"][1]["ephemeral"][
+            "volumeClaimTemplate"]["spec"]["dataSourceRef"]
+        assert ref == {"kind": "VolumeSnapshot", "name": "ckpt-snap"}
+        # ...and a malformed legacy source still rejects.
+        tpl["spec"]["volumes"] = [{"name": "pd", "gcePersistentDisk": {}}]
+        errs = validate_tpujob_object(job_dict(tpl))
+        assert any("missing required field 'pdName'" in e for e in errs)
+
+    def test_unknown_probe_fields_prune_instead_of_surviving(self):
+        tpl = good_template()
+        tpl["spec"]["containers"][0]["livenessProbe"] = {
+            "tcpSocket": {"port": 1}, "frequencySeconds": 9,
+        }
+        assert validate_tpujob_object(job_dict(tpl)) == []
+        pruned = prune(tpl, pod_template_schema())
+        probe = pruned["spec"]["containers"][0]["livenessProbe"]
+        assert "frequencySeconds" not in probe
+        assert probe["tcpSocket"] == {"port": 1}
+
+
 class TestPruneSemantics:
     def test_unknown_fields_prune_not_error(self):
         tpl = good_template()
@@ -130,7 +306,7 @@ class TestPruneSemantics:
         assert "madeUpField" not in pruned["spec"]
         assert pruned["spec"]["containers"] == tpl["spec"]["containers"]
 
-    def test_preserved_subtrees_keep_unknowns(self):
+    def test_typed_subtree_contents_survive_prune(self):
         tpl = good_template()
         tpl["spec"]["containers"][0]["securityContext"] = {"runAsUser": 1000}
         tpl["spec"]["volumes"][0]["emptyDir"] = {"medium": "Memory"}
