@@ -24,11 +24,13 @@ manifests:
 verify-manifests:
 	$(PYTHON) hack/gen_manifests.py --verify
 
-# No third-party linter is vendored in the image; lint = bytecode-compile
-# every source tree (catches syntax/undefined-future errors) + generated
-# manifests in sync.
+# Static-analysis tier (golangci-lint analog): bytecode-compile with
+# SyntaxWarnings promoted to errors, the AST linter (hack/lint.py:
+# unused imports, mutable defaults, bare excepts, dead redefinitions),
+# and generated manifests in sync.
 lint: verify-manifests
-	$(PYTHON) -m compileall -q mpi_operator_tpu sdk hack tests bench.py __graft_entry__.py
+	$(PYTHON) -W error::SyntaxWarning -m compileall -q -f mpi_operator_tpu sdk hack tests bench.py __graft_entry__.py
+	$(PYTHON) hack/lint.py
 
 # Test tiers (SURVEY.md §4): unit, integration (in-memory apiserver +
 # envtest-style HTTP kube backend), e2e (real subprocess workers doing

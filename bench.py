@@ -417,31 +417,19 @@ def bench_llama(args) -> dict:
 # ---------------------------------------------------------------------------
 
 
-def bench_startup(args) -> dict:
-    """TPUJob create → pi job Succeeded through the full operator stack
-    (reconciler, pod runner, gang barrier, jax.distributed rendezvous,
-    one collective). The reference's only latency figure is its e2e bound:
-    pi Succeeded ≤ 200 s on a kind cluster."""
-    import os
-    import pathlib
+def _startup_once(api, root) -> float:
+    """One pi run: TPUJob create → Succeeded through the full operator
+    stack (reconciler, pod runner, gang barrier, jax.distributed
+    rendezvous, one collective), all against ``api``."""
     import threading
-
-    # The workload is operator machinery + subprocess workers on the JAX
-    # CPU backend — force CPU in THIS process too so nothing touches a
-    # real chip mid-benchmark.
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     import yaml
 
     from mpi_operator_tpu.controller.tpu_job_controller import TPUJobController
-    from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer
     from mpi_operator_tpu.runtime.podrunner import LocalPodRunner
     from mpi_operator_tpu.utils.net import free_port_pair
 
-    root = pathlib.Path(__file__).resolve().parent
     port = free_port_pair()  # the gang barrier binds port+1 too
-
-    api = InMemoryAPIServer()
     controller = TPUJobController(api)
     runner = LocalPodRunner(api, workdir=str(root))
     stop = threading.Event()
@@ -470,14 +458,48 @@ def bench_startup(args) -> dict:
         runner.stop()
     if elapsed is None:
         raise RuntimeError("pi job did not reach Succeeded within the bound")
-    log(f"pi e2e: create -> Succeeded in {elapsed:.1f}s "
-        f"(reference bound {BASELINE_E2E_BOUND_S:.0f}s)")
+    return elapsed
+
+
+def bench_startup(args) -> dict:
+    """Startup-to-Succeeded twice: once against the in-memory apiserver
+    (framework floor) and once with controller, pod runner, AND client
+    all talking REST to the HTTP apiserver frontend — so the published
+    number includes real apiserver round-trips, matching the shape of
+    the reference's kind-cluster bound (pi Succeeded ≤ 200 s)."""
+    import os
+    import pathlib
+
+    # The workload is operator machinery + subprocess workers on the JAX
+    # CPU backend — force CPU in THIS process too so nothing touches a
+    # real chip mid-benchmark.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer
+    from mpi_operator_tpu.runtime.httpserver import APIServerFrontend
+    from mpi_operator_tpu.runtime.kube import KubeAPIServer, RestConfig
+
+    root = pathlib.Path(__file__).resolve().parent
+
+    mem_s = _startup_once(InMemoryAPIServer(), root)
+    log(f"pi e2e (in-memory backend): create -> Succeeded in {mem_s:.1f}s")
+
+    fe = APIServerFrontend(InMemoryAPIServer()).start()
+    kube = KubeAPIServer(RestConfig(host=fe.url))
+    try:
+        rest_s = _startup_once(kube, root)
+    finally:
+        kube.close()
+        fe.stop()
+    log(f"pi e2e (REST backend, everything over HTTP): create -> "
+        f"Succeeded in {rest_s:.1f}s "
+        f"(reference kind-cluster bound {BASELINE_E2E_BOUND_S:.0f}s)")
     return {
         "metric": "pi_e2e_startup_to_succeeded_seconds",
-        "value": round(elapsed, 2),
+        "value": round(rest_s, 2),
         "unit": "seconds",
         # >1 = faster than the reference's 200 s e2e bound.
-        "vs_baseline": round(BASELINE_E2E_BOUND_S / elapsed, 2),
+        "vs_baseline": round(BASELINE_E2E_BOUND_S / rest_s, 2),
     }
 
 

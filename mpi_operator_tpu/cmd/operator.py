@@ -23,7 +23,6 @@ Run:  python -m mpi_operator_tpu.cmd.operator --help
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import socket
 import sys
@@ -33,7 +32,6 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..api.v2beta1 import constants
-from ..controller import status as st
 from ..controller.tpu_job_controller import TPUJobController
 from ..runtime.apiserver import InMemoryAPIServer, NotFoundError
 from ..runtime.leaderelection import LeaderElectionConfig, LeaderElector

@@ -30,7 +30,7 @@ import json
 import logging
 import sys
 import time
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 log = logging.getLogger("tpujob.train")
 

@@ -30,7 +30,6 @@ from ..api.v2beta1 import constants
 from ..api.v2beta1.defaults import set_defaults_tpujob
 from ..api.v2beta1.types import (
     API_VERSION,
-    GROUP_NAME,
     JOB_CREATED,
     JOB_FAILED,
     JOB_RESTARTING,

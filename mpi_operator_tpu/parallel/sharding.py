@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from .mesh import DP, FSDP, SP, TP
+from .mesh import DP, FSDP, SP
 
 
 def batch_spec(mesh, *, sequence_axis: Optional[int] = None):

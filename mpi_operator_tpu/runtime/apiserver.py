@@ -27,8 +27,8 @@ import itertools
 import threading
 import time
 import uuid
-from dataclasses import dataclass, field
-from typing import Any, Callable, Iterable, Optional
+from dataclasses import dataclass
+from typing import Callable, Optional
 
 
 class ApiError(Exception):

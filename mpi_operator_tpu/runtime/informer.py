@@ -21,7 +21,7 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from .apiserver import ADDED, DELETED, MODIFIED, InMemoryAPIServer, match_labels
+from .apiserver import ADDED, DELETED, InMemoryAPIServer, match_labels
 
 
 def split_key(key: str) -> tuple[str, str]:

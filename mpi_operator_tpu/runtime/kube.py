@@ -56,7 +56,6 @@ from .apiserver import (
     InvalidError,
     NotFoundError,
     WatchEvent,
-    match_labels,
 )
 
 log = logging.getLogger("tpujob.kube")

@@ -10,7 +10,6 @@ from mpi_operator_tpu.api.v2beta1 import (
     REPLICA_TYPE_WORKER,
     JAXDistributionSpec,
     ReplicaSpec,
-    RunPolicy,
     TPUJob,
     TPUJobSpec,
     TPUSpec,

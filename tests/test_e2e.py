@@ -14,8 +14,6 @@ import time
 import pytest
 import yaml
 
-from mpi_operator_tpu.api.v2beta1 import TPUJob
-from mpi_operator_tpu.controller import status as st
 from mpi_operator_tpu.controller.tpu_job_controller import TPUJobController
 from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer
 from mpi_operator_tpu.runtime.podrunner import LocalPodRunner

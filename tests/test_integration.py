@@ -25,7 +25,6 @@ from mpi_operator_tpu.api.v2beta1.types import (
     TPUJobSpec,
     TPUSpec,
 )
-from mpi_operator_tpu.controller import builders
 from mpi_operator_tpu.controller import status as st
 from mpi_operator_tpu.controller.tpu_job_controller import TPUJobController
 from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer

@@ -439,7 +439,6 @@ class TestKubeconfig:
     def test_exec_credential_plugin(self, tmp_path):
         # The GKE/EKS mechanism: user.exec runs a plugin that prints an
         # ExecCredential with a bearer token.
-        import os
         import stat
 
         plugin = tmp_path / "fake-auth-plugin"

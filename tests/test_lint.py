@@ -1,0 +1,102 @@
+"""The AST lint tier (hack/lint.py): catches the defect classes it
+advertises, stays quiet on clean code, and the repo itself is clean."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "hack"))
+from lint import check_file  # noqa: E402
+
+
+def _lint_src(tmp_path, src: str, name: str = "mod.py"):
+    f = tmp_path / name
+    f.write_text(src)
+    return check_file(f)
+
+
+class TestLintRules:
+    def test_unused_import_flagged(self, tmp_path):
+        errs = _lint_src(tmp_path, "import os\nimport sys\nprint(sys.path)\n")
+        assert len(errs) == 1 and "F401 'os'" in errs[0]
+
+    def test_attribute_use_counts(self, tmp_path):
+        assert _lint_src(tmp_path, "import os\nprint(os.path.sep)\n") == []
+
+    def test_init_reexports_exempt(self, tmp_path):
+        errs = _lint_src(
+            tmp_path, "from .api import TPUJob\n", name="__init__.py"
+        )
+        assert errs == []
+
+    def test_explicit_reexport_alias_exempt(self, tmp_path):
+        errs = _lint_src(tmp_path, "from .api import TPUJob as TPUJob\n")
+        assert errs == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        errs = _lint_src(tmp_path, "import os  # noqa: F401\n")
+        assert errs == []
+
+    def test_mutable_default_flagged(self, tmp_path):
+        errs = _lint_src(tmp_path, "def f(x, acc=[]):\n    return acc\n")
+        assert len(errs) == 1 and "B006" in errs[0]
+
+    def test_bare_except_flagged(self, tmp_path):
+        errs = _lint_src(
+            tmp_path, "try:\n    pass\nexcept:\n    pass\n"
+        )
+        assert len(errs) == 1 and "E722" in errs[0]
+
+    def test_fstring_without_placeholder_flagged(self, tmp_path):
+        errs = _lint_src(tmp_path, "x = f'static'\n")
+        assert len(errs) == 1 and "F541" in errs[0]
+
+    def test_format_spec_not_flagged(self, tmp_path):
+        # {v:.1f} parses as a nested JoinedStr — must not trip F541.
+        assert _lint_src(tmp_path, "v = 1.0\nx = f'{v:.1f}'\n") == []
+
+    def test_redefinition_flagged(self, tmp_path):
+        errs = _lint_src(
+            tmp_path,
+            "def f():\n    pass\ndef f():\n    pass\n",
+        )
+        assert len(errs) == 1 and "F811" in errs[0]
+
+    def test_overload_stubs_not_flagged(self, tmp_path):
+        src = (
+            "from typing import overload\n"
+            "@overload\n"
+            "def f(x: int) -> int: ...\n"
+            "@overload\n"
+            "def f(x: str) -> str: ...\n"
+            "def f(x):\n"
+            "    return x\n"
+        )
+        assert _lint_src(tmp_path, src) == []
+
+    def test_coded_noqa_is_not_blanket(self, tmp_path):
+        # "# noqa: N802" must not mask an unrelated F401 on the line.
+        errs = _lint_src(tmp_path, "import os  # noqa: N802\n")
+        assert len(errs) == 1 and "F401" in errs[0]
+        assert _lint_src(tmp_path, "import os  # noqa: F401,N802\n") == []
+
+    def test_property_setter_not_flagged(self, tmp_path):
+        src = (
+            "class C:\n"
+            "    @property\n"
+            "    def x(self):\n"
+            "        return 1\n"
+            "    @x.setter\n"
+            "    def x(self, v):\n"
+            "        pass\n"
+        )
+        assert _lint_src(tmp_path, src) == []
+
+
+def test_repo_is_clean():
+    repo = Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, str(repo / "hack" / "lint.py")],
+        capture_output=True, text=True, cwd=repo,
+    )
+    assert out.returncode == 0, out.stdout[-2000:]

@@ -11,7 +11,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 from mpi_operator_tpu.models import llama as llama_lib
 from mpi_operator_tpu.models.moe import (
