@@ -253,13 +253,14 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
     pp = sizes["pp"]
     dp = sizes.get("dp", 1)
     fsdp = sizes.get("fsdp", 1)
+    tp = sizes.get("tp", 1)
     unsupported = [a for a, n in sizes.items()
-                   if a not in ("dp", "fsdp", "pp") and n > 1]
+                   if a not in ("dp", "fsdp", "pp", "tp") and n > 1]
     if unsupported:
         raise SystemExit(
-            f"pp meshes compose with dp and fsdp only; {unsupported} "
-            f"would silently replicate work/params (tp/sp are not wired "
-            f"through the pipelined llama)"
+            f"pp meshes compose with dp, fsdp, and tp; {unsupported} "
+            f"would silently replicate work/params (sp is not wired "
+            f"through the pipelined llama — ring/ulysses own it)"
         )
     if args.data:
         raise SystemExit(
@@ -282,6 +283,15 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
         raise SystemExit(
             f"model dims (dim={cfg.dim}, ffn_dim={cfg.ffn_dim}) must "
             f"both divide by fsdp={fsdp}"
+        )
+    if tp > 1 and (cfg.n_heads % tp or cfg.n_kv_heads % tp
+                   or cfg.ffn_dim % tp or cfg.dim % tp):
+        # Kernel OUTPUT dims shard over tp (_block_leaf_placement):
+        # qkv -> head counts, w_gate/w_up -> ffn_dim, wo/w_down -> dim.
+        raise SystemExit(
+            f"heads ({cfg.n_heads} q / {cfg.n_kv_heads} kv), ffn_dim "
+            f"({cfg.ffn_dim}), and dim ({cfg.dim}) must all divide by "
+            f"tp={tp}"
         )
     mb = args.pp_microbatch
     if not mb:

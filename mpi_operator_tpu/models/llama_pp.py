@@ -19,6 +19,12 @@ The reference delegates pipelining to user MPI programs entirely
 (SURVEY.md §2.4 "TP/PP/SP: absent"); this is the framework-owned
 equivalent, built as pure SPMD collectives.
 
+Tensor parallelism composes too: the pipeline's shard_map is manual
+over pp/dp/fsdp only and leaves ``tp`` an AUTO axis, so GSPMD keeps
+inserting the Megatron column/row collectives inside each stage while
+activations ppermute between stages (kernel output features shard over
+tp, ``_block_leaf_placement``).
+
 Restrictions: dense Llama only (MoE routes tokens through an ep
 all-to-all that would fight the stage ppermute), flash or dense
 attention inside stages (ring/ulysses own sp; pp x sp composition is
@@ -35,21 +41,40 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import DP, FSDP, PP
+from ..parallel.mesh import DP, FSDP, PP, TP
 from ..parallel.pipeline import microbatch, pipeline, unmicrobatch
 from .llama import Block, LlamaConfig, RMSNorm, remat_policy_for
 
 
-def _fsdp_size(mesh) -> int:
+def _axis_size(mesh, name) -> int:
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    return sizes.get(FSDP, 1)
+    return sizes.get(name, 1)
+
+
+def _fsdp_size(mesh) -> int:
+    return _axis_size(mesh, FSDP)
 
 
 def _block_leaf_spec(leaf) -> P:
-    """Spec for one stage-stacked block leaf [P, L/P, d, ...]: stage dim
-    over pp, the first weight dim over fsdp (ZeRO-3 storage; stages
-    all-gather a layer's weights just before using it)."""
+    """MANUAL-axis spec for one stage-stacked block leaf [P, L/P, d, ...]:
+    stage dim over pp, the first weight dim over fsdp (ZeRO-3 storage;
+    stages all-gather a layer's weights just before using it). tp never
+    appears here — it stays an AUTO axis inside the pipeline's
+    shard_map, managed by GSPMD."""
     return P(PP, None, FSDP, *([None] * (leaf.ndim - 3)))
+
+
+def _block_leaf_placement(leaf, fsdp: bool, tp: bool) -> P:
+    """STORAGE spec for a stage-stacked block leaf: the manual spec
+    plus, for matrix kernels ([P, L/P, in, out] — norm scales are 3-D),
+    the output-feature dim over tp. GSPMD reads this layout at the
+    shard_map boundary and inserts the tp collectives inside the
+    stages."""
+    spec = list(_block_leaf_spec(leaf)) if fsdp else (
+        [PP] + [None] * (leaf.ndim - 1))
+    if tp and leaf.ndim >= 4:
+        spec[-1] = TP
+    return P(*spec)
 
 
 def stack_block_params(params, n_layers: int, n_stages: int):
@@ -118,14 +143,16 @@ def pp_params_from_init(params, cfg: LlamaConfig, n_stages: int):
 def shard_pp_params(pp_params, mesh):
     """Blocks shard over pp on the stage dim — and, when the mesh has an
     fsdp axis, over fsdp on the first weight dim (ZeRO-3 storage; the
-    stage loop all-gathers one layer at a time). Embed/norm/head
+    stage loop all-gathers one layer at a time), and over tp on kernel
+    output features (GSPMD-managed inside the stages). Embed/norm/head
     replicate: they are used on every stage and are a sliver of the
     block weights for deep models."""
     fsdp = _fsdp_size(mesh) > 1
+    tp = _axis_size(mesh, TP) > 1
     blocks = jax.tree_util.tree_map(
         lambda w: jax.device_put(
             w,
-            NamedSharding(mesh, _block_leaf_spec(w) if fsdp else P(PP)),
+            NamedSharding(mesh, _block_leaf_placement(w, fsdp, tp)),
         ),
         pp_params["blocks"],
     )
@@ -149,10 +176,13 @@ def shard_pp_opt_state(opt_state, mesh):
     fsdp = _fsdp_size(mesh) > 1
     repl = NamedSharding(mesh, P())
 
+    tp = _axis_size(mesh, TP) > 1
+
     def place(w):
         if getattr(w, "ndim", 0) >= 3:
-            spec = _block_leaf_spec(w) if fsdp else P(PP)
-            return jax.device_put(w, NamedSharding(mesh, spec))
+            return jax.device_put(
+                w, NamedSharding(mesh, _block_leaf_placement(w, fsdp, tp))
+            )
         return jax.device_put(w, repl)
 
     return jax.tree_util.tree_map(place, opt_state)
@@ -172,11 +202,16 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
     block = Block(cfg)
     names = mesh.axis_names
     fsdp = _fsdp_size(mesh) > 1
+    tp = _axis_size(mesh, TP) > 1
     # Microbatch rows shard over every batch axis (dp AND fsdp — the
     # same layout shard_batch produces); leaving fsdp off forces XLA to
     # replicate-and-repartition activations at the shard_map boundary.
     batch_axes = tuple(a for a in (DP, FSDP) if a in names)
     state_spec = P(batch_axes if batch_axes else None, None, None)
+    # tp stays an AUTO axis: the pipeline shard_map is manual over
+    # pp/dp/fsdp only, so GSPMD keeps inserting the tensor-parallel
+    # collectives (Megatron column/row splits) inside each stage.
+    manual = frozenset(a for a in names if a != TP) if tp else None
 
     def stage_fn(stage_params, h):
         positions = jnp.broadcast_to(
@@ -218,6 +253,7 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
             params_spec=jax.tree_util.tree_map(
                 _block_leaf_spec, params["blocks"]
             ) if fsdp else None,
+            manual_axes=manual,
         )
         h = unmicrobatch(y)
         h = RMSNorm(cfg.norm_eps).apply(

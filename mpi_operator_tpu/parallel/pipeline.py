@@ -50,6 +50,7 @@ def pipeline(
     axis: str = PP,
     state_spec: Optional[P] = None,
     params_spec=None,
+    manual_axes=None,
 ):
     """Run ``fn`` as a P-stage pipeline over microbatched input.
 
@@ -68,6 +69,13 @@ def pipeline(
                    the non-stage dims too — e.g. ZeRO-3 weight sharding
                    over fsdp, with ``fn`` doing the all-gather. Default:
                    every leaf P(axis) (stage dim only, rest replicated).
+    manual_axes:   mesh axes to run in manual (shard_map) mode; the
+                   REST stay automatic, so GSPMD keeps inserting their
+                   collectives inside the stage fn — this is how tp
+                   composes with the pipeline without hand-writing
+                   Megatron psums. Default: every mesh axis manual
+                   (classic shard_map). Must include ``axis``, and
+                   specs may only name manual axes.
 
     Returns [M, mb, ...] outputs (replicated over ``axis``).
     """
@@ -156,12 +164,22 @@ def pipeline(
             jnp.where(i == n - 1, outputs, jnp.zeros_like(outputs)), axis
         )
 
+    kw = {}
+    if manual_axes is not None:
+        manual_axes = frozenset(manual_axes)
+        if axis not in manual_axes:
+            raise ValueError(
+                f"manual_axes {sorted(manual_axes)} must include the "
+                f"pipeline axis {axis!r}"
+            )
+        kw["axis_names"] = manual_axes
     return shard_map(
         per_shard,
         mesh=mesh,
         in_specs=(params_spec, x_spec),
         out_specs=x_spec,
         check_vma=False,  # fn may contain pallas kernels (see ring_attention)
+        **kw,
     )(stage_params, x)
 
 
