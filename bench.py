@@ -170,8 +170,19 @@ def bench_resnet(args) -> dict:
     log(f"devices: {n} x {devices[0].device_kind}")
     mesh = create_mesh(dp=-1, devices=devices)
 
+    if args.bn_kernel == "pallas" and n > 1:
+        # GSPMD has no partitioning rule for the pallas stats kernels —
+        # a batch-sharded mesh would all-gather every BN layer's
+        # activations (or fail to compile) and the number would be
+        # meaningless.
+        raise SystemExit(
+            f"--bn-kernel pallas benches the single-chip path; this host "
+            f"exposes {n} devices"
+        )
     s2d = not args.no_s2d and args.image_size % 2 == 0
-    model = resnet_lib.resnet(args.depth, space_to_depth=s2d)
+    model = resnet_lib.resnet(
+        args.depth, space_to_depth=s2d, bn_impl=args.bn_kernel
+    )
     rng = jax.random.PRNGKey(0)
     params, batch_stats = resnet_lib.create_train_state(
         model, rng, image_size=args.image_size
@@ -546,6 +557,11 @@ def main() -> int:
     parser.add_argument("--no-s2d", action="store_true",
                         help="disable the space-to-depth ResNet stem "
                              "(the MLPerf TPU transform; on by default)")
+    parser.add_argument("--bn-kernel", choices=["xla", "pallas"],
+                        default="xla",
+                        help="BN reduction path: XLA's convert_reduce "
+                             "fusions or the fused pallas stats/grads "
+                             "kernels (ops/bn.py; single-chip dp mesh)")
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument("--profile-dir", default="")

@@ -98,14 +98,23 @@ class ResNet(nn.Module):
     # kernel maps exactly onto the 4x4 layout (see s2d_stem_kernel);
     # training from scratch just initializes the 4x4 form directly.
     space_to_depth: bool = False
+    # BN reductions are half the train step (PERF.md); "pallas" routes
+    # the stats and dγ/dβ passes through ops/bn.py's fused kernels.
+    bn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(
             nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32
         )
+        if self.bn_impl == "pallas":
+            from ..ops.bn import TpuBatchNorm as _BN
+        elif self.bn_impl == "xla":
+            _BN = nn.BatchNorm
+        else:
+            raise ValueError(f"unknown bn_impl {self.bn_impl!r}")
         norm = partial(
-            nn.BatchNorm,
+            _BN,
             use_running_average=not train,
             momentum=0.9,
             epsilon=1e-5,
@@ -156,6 +165,7 @@ def resnet(
     num_classes: int = 1000,
     dtype=jnp.bfloat16,
     space_to_depth: bool = False,
+    bn_impl: str = "xla",
 ) -> ResNet:
     return ResNet(
         stage_sizes=STAGE_SIZES[depth],
@@ -163,6 +173,7 @@ def resnet(
         num_classes=num_classes,
         dtype=dtype,
         space_to_depth=space_to_depth,
+        bn_impl=bn_impl,
     )
 
 

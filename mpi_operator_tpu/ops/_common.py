@@ -1,0 +1,11 @@
+"""Shared helpers for the pallas kernels in this package."""
+
+from __future__ import annotations
+
+import jax
+
+
+def use_interpret() -> bool:
+    """Pallas interpret mode off-TPU: the same kernels execute (slowly)
+    on CPU/GPU backends, so numerics are validated everywhere."""
+    return jax.default_backend() != "tpu"

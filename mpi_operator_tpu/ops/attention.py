@@ -28,6 +28,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._common import use_interpret as _use_interpret
+
 NEG_INF = -1e30  # safe "minus infinity": avoids inf-inf → nan in masking
 
 # Sentinel ids used to encode padding inside explicit row/col id vectors:
@@ -282,10 +284,6 @@ def _pad_to(x, axis: int, multiple: int):
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, pad)
     return jnp.pad(x, widths)
-
-
-def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _pad_ids(ids, multiple: int, fill: int):

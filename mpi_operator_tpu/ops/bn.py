@@ -1,0 +1,238 @@
+"""Fused batch-norm statistics/gradient reductions as pallas kernels.
+
+The ResNet trace (PERF.md) shows BN reductions are 50% of the train
+step at only ~40% of peak HBM bandwidth: XLA emits one
+``convert_reduce_fusion`` per BN layer forward (bf16→f32 convert, then
+mean+var) and one backward (dγ/dβ), each a fresh pass whose tiling the
+compiler picks. These kernels make the two passes explicit with shapes
+chosen for the memory system — [rows, C] tiles streamed once, f32
+accumulators in VMEM, both moments (or both gradient sums) from the
+SAME read.
+
+``TpuBatchNorm`` is the drop-in ``nn.BatchNorm`` replacement wired to
+them (``models/resnet.py`` selects it via ``bn_impl="pallas"``); the
+normalize/apply stays ordinary XLA elementwise so it keeps fusing into
+neighbors. On non-TPU backends the kernels run in interpret mode, so
+numerics are validated everywhere (tests/test_bn.py asserts exact
+agreement with ``nn.BatchNorm`` forward AND backward).
+
+Reference analog: none — the reference delegates models entirely to
+user images (SURVEY.md §2.3); this is framework-owned TPU perf work on
+its benchmark family (reference README.md:175-206 trains ResNet-101).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.experimental import pallas as pl
+
+from ._common import use_interpret as _use_interpret
+
+DEFAULT_TILE_M = 512
+
+
+def _row_mask(shape, base, m):
+    rows = jax.lax.broadcasted_iota(jnp.int32, shape, 0) + base
+    return rows < m
+
+
+def _zero_padding(x, base, m):
+    """Zero grid-padding rows with a select, NOT a multiply: padding
+    reads uninitialized VMEM on real TPUs, and 0*NaN = NaN would poison
+    the channel sums."""
+    return jnp.where(_row_mask(x.shape, base, m), x, 0.0)
+
+
+def _stats_kernel(x_ref, sum_ref, sq_ref, *, m, tile_m):
+    i = pl.program_id(0)
+    x = _zero_padding(x_ref[...].astype(jnp.float32), i * tile_m, m)
+    s = jnp.sum(x, axis=0)
+    q = jnp.sum(x * x, axis=0)
+
+    @pl.when(i == 0)
+    def _init():
+        sum_ref[...] = s
+        sq_ref[...] = q
+
+    @pl.when(i > 0)
+    def _accumulate():
+        sum_ref[...] += s
+        sq_ref[...] += q
+
+
+def bn_stats(x2d, *, tile_m: int = DEFAULT_TILE_M):
+    """Per-channel (sum, sum-of-squares) of an [M, C] array in ONE pass,
+    f32 accumulation regardless of input dtype. Returns two f32 [C]."""
+    m, c = x2d.shape
+    tile_m = min(tile_m, max(8, m))
+    grid = (m + tile_m - 1) // tile_m
+    return pl.pallas_call(
+        functools.partial(_stats_kernel, m=m, tile_m=tile_m),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((tile_m, c), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((c,), jnp.float32),
+            jax.ShapeDtypeStruct((c,), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(x2d)
+
+
+def _grads_kernel(dy_ref, x_ref, mean_ref, inv_ref, db_ref, dg_ref,
+                  *, m, tile_m):
+    i = pl.program_id(0)
+    dy = _zero_padding(dy_ref[...].astype(jnp.float32), i * tile_m, m)
+    # x too: its padding feeds xhat, and even zeroed-dy rows would
+    # contribute NaN via 0·NaN.
+    x = _zero_padding(x_ref[...].astype(jnp.float32), i * tile_m, m)
+    xhat = (x - mean_ref[...]) * inv_ref[...]
+    db = jnp.sum(dy, axis=0)
+    dg = jnp.sum(dy * xhat, axis=0)
+
+    @pl.when(i == 0)
+    def _init():
+        db_ref[...] = db
+        dg_ref[...] = dg
+
+    @pl.when(i > 0)
+    def _accumulate():
+        db_ref[...] += db
+        dg_ref[...] += dg
+
+
+def bn_grads(dy2d, x2d, mean, inv_std, *, tile_m: int = DEFAULT_TILE_M):
+    """Per-channel (dβ, dγ) = (Σdy, Σ dy·x̂) from ONE fused pass over
+    (dy, x). Returns two f32 [C]."""
+    m, c = dy2d.shape
+    tile_m = min(tile_m, max(8, m))
+    grid = (m + tile_m - 1) // tile_m
+    return pl.pallas_call(
+        functools.partial(_grads_kernel, m=m, tile_m=tile_m),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((tile_m, c), lambda i: (i, 0)),
+            pl.BlockSpec((tile_m, c), lambda i: (i, 0)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((c,), jnp.float32),
+            jax.ShapeDtypeStruct((c,), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(dy2d, x2d, mean, inv_std)
+
+
+# ---------------------------------------------------------------------------
+# Fused training batch norm (custom VJP around the two kernels)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_batch_norm(x, gamma, beta, eps):
+    """Returns (y, mean, var). mean/var are emitted as extra outputs so
+    the running-stat update reuses the SAME stats pass (a separate call
+    would not CSE across the custom_vjp boundary); their cotangents are
+    ignored in the backward — callers must stop_gradient them."""
+    y, mean, var, _ = _fbn_fwd_impl(x, gamma, beta, eps)
+    return y, mean, var
+
+
+def _fbn_fwd_impl(x, gamma, beta, eps):
+    c = x.shape[-1]
+    m = int(np.prod(x.shape[:-1]))
+    s, q = bn_stats(x.reshape(m, c))
+    mean = s / m
+    # E[x²]−E[x]² (both moments from one read); clamp the catastrophic-
+    # cancellation tail the same way XLA's fused batchnorm does.
+    var = jnp.maximum(q / m - mean * mean, 0.0)
+    inv = jax.lax.rsqrt(var + eps)
+    # Apply stays XLA elementwise: it fuses with the surrounding
+    # relu/add, f32 math lives in registers, y lands back in x.dtype.
+    y = ((x.astype(jnp.float32) - mean) * (inv * gamma) + beta).astype(
+        x.dtype
+    )
+    return y, mean, var, inv
+
+
+def _fbn_fwd(x, gamma, beta, eps):
+    y, mean, var, inv = _fbn_fwd_impl(x, gamma, beta, eps)
+    return (y, mean, var), (x, gamma, mean, inv)
+
+
+def _fbn_bwd(eps, res, cts):
+    dy, _dmean, _dvar = cts  # moments are stop-gradiented by callers
+    x, gamma, mean, inv = res
+    c = x.shape[-1]
+    m = int(np.prod(x.shape[:-1]))
+    db, dg = bn_grads(dy.reshape(m, c), x.reshape(m, c), mean, inv)
+    # Training-mode BN backward (mean/var differentiate through):
+    # dx = γ·inv/M · (M·dy − dβ − x̂·dγ)
+    xhat = (x.astype(jnp.float32) - mean) * inv
+    dx = ((gamma * inv) * (
+        dy.astype(jnp.float32) - db / m - xhat * (dg / m)
+    )).astype(x.dtype)
+    return dx, dg.astype(gamma.dtype), db.astype(gamma.dtype)
+
+
+fused_batch_norm.defvjp(_fbn_fwd, _fbn_bwd)
+
+
+def batch_norm_train(x, gamma, beta, eps):
+    """Fused BN plus the (stop-gradiented) batch moments for running-
+    stat updates."""
+    y, mean, var = fused_batch_norm(x, gamma, beta, eps)
+    return y, jax.lax.stop_gradient(mean), jax.lax.stop_gradient(var)
+
+
+class TpuBatchNorm(nn.Module):
+    """``nn.BatchNorm`` drop-in (the subset ResNet uses) running its
+    reductions through the pallas kernels. Same variable collections
+    ('batch_stats': mean/var), same init, same eval-mode math."""
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scale_init: Callable = nn.initializers.ones_init()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        scale = self.param("scale", self.scale_init, (c,), self.param_dtype)
+        bias = self.param("bias", self.bias_init, (c,), self.param_dtype)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((c,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((c,), jnp.float32)
+        )
+        if self.use_running_average:
+            inv = jax.lax.rsqrt(ra_var.value + self.epsilon)
+            y = (x.astype(jnp.float32) - ra_mean.value) * (inv * scale) + bias
+            return y.astype(self.dtype)
+        y, mean, var = batch_norm_train(x, scale, bias, self.epsilon)
+        if not self.is_initializing():
+            ra_mean.value = (
+                self.momentum * ra_mean.value + (1 - self.momentum) * mean
+            )
+            ra_var.value = (
+                self.momentum * ra_var.value + (1 - self.momentum) * var
+            )
+        return y
