@@ -170,15 +170,10 @@ def bench_resnet(args) -> dict:
     log(f"devices: {n} x {devices[0].device_kind}")
     mesh = create_mesh(dp=-1, devices=devices)
 
-    if args.bn_kernel == "pallas" and n > 1:
-        # GSPMD has no partitioning rule for the pallas stats kernels —
-        # a batch-sharded mesh would all-gather every BN layer's
-        # activations (or fail to compile) and the number would be
-        # meaningless.
-        raise SystemExit(
-            f"--bn-kernel pallas benches the single-chip path; this host "
-            f"exposes {n} devices"
-        )
+    if args.bn_kernel == "pallas":
+        from mpi_operator_tpu.ops.bn import require_single_device
+
+        require_single_device(n)
     s2d = not args.no_s2d and args.image_size % 2 == 0
     model = resnet_lib.resnet(
         args.depth, space_to_depth=s2d, bn_impl=args.bn_kernel

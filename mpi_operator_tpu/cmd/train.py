@@ -67,6 +67,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--global-batch", type=int, default=0,
                    help="0 = pick per model (resnet: 64/chip; lm: 8/chip)")
     p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--bn-kernel", choices=["xla", "pallas"], default="xla",
+                   help="resnet BN reduction path (pallas = fused "
+                        "ops/bn.py kernels; single-device meshes only)")
     p.add_argument("--seq-len", type=int, default=512)
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--checkpoint-dir", default="")
@@ -171,7 +174,11 @@ def _resnet_workload(args, mesh, n_devices: int) -> Workload:
         )
     depth = int(args.model.removeprefix("resnet"))
     global_batch = args.global_batch or 64 * n_devices
-    model = resnet_lib.resnet(depth)
+    if args.bn_kernel == "pallas":
+        from ..ops.bn import require_single_device
+
+        require_single_device(n_devices)
+    model = resnet_lib.resnet(depth, bn_impl=args.bn_kernel)
     params, batch_stats = resnet_lib.create_train_state(
         model, jax.random.PRNGKey(args.seed), image_size=args.image_size
     )

@@ -52,8 +52,10 @@ def _zero_padding(x, base, m):
 def _stats_kernel(x_ref, sum_ref, sq_ref, *, m, tile_m):
     i = pl.program_id(0)
     x = _zero_padding(x_ref[...].astype(jnp.float32), i * tile_m, m)
-    s = jnp.sum(x, axis=0)
-    q = jnp.sum(x * x, axis=0)
+    # Per-channel vectors ride as [1, C] blocks: TPU pallas wants >=2-D
+    # operands (see attention.py:_pad_ids for the same workaround).
+    s = jnp.sum(x, axis=0, keepdims=True)
+    q = jnp.sum(x * x, axis=0, keepdims=True)
 
     @pl.when(i == 0)
     def _init():
@@ -72,20 +74,21 @@ def bn_stats(x2d, *, tile_m: int = DEFAULT_TILE_M):
     m, c = x2d.shape
     tile_m = min(tile_m, max(8, m))
     grid = (m + tile_m - 1) // tile_m
-    return pl.pallas_call(
+    s, q = pl.pallas_call(
         functools.partial(_stats_kernel, m=m, tile_m=tile_m),
         grid=(grid,),
         in_specs=[pl.BlockSpec((tile_m, c), lambda i: (i, 0))],
         out_specs=[
-            pl.BlockSpec((c,), lambda i: (0,)),
-            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((c,), jnp.float32),
-            jax.ShapeDtypeStruct((c,), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
         ],
         interpret=_use_interpret(),
     )(x2d)
+    return s[0], q[0]
 
 
 def _grads_kernel(dy_ref, x_ref, mean_ref, inv_ref, db_ref, dg_ref,
@@ -96,8 +99,8 @@ def _grads_kernel(dy_ref, x_ref, mean_ref, inv_ref, db_ref, dg_ref,
     # contribute NaN via 0·NaN.
     x = _zero_padding(x_ref[...].astype(jnp.float32), i * tile_m, m)
     xhat = (x - mean_ref[...]) * inv_ref[...]
-    db = jnp.sum(dy, axis=0)
-    dg = jnp.sum(dy * xhat, axis=0)
+    db = jnp.sum(dy, axis=0, keepdims=True)
+    dg = jnp.sum(dy * xhat, axis=0, keepdims=True)
 
     @pl.when(i == 0)
     def _init():
@@ -116,25 +119,26 @@ def bn_grads(dy2d, x2d, mean, inv_std, *, tile_m: int = DEFAULT_TILE_M):
     m, c = dy2d.shape
     tile_m = min(tile_m, max(8, m))
     grid = (m + tile_m - 1) // tile_m
-    return pl.pallas_call(
+    db, dg = pl.pallas_call(
         functools.partial(_grads_kernel, m=m, tile_m=tile_m),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((tile_m, c), lambda i: (i, 0)),
             pl.BlockSpec((tile_m, c), lambda i: (i, 0)),
-            pl.BlockSpec((c,), lambda i: (0,)),
-            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((c,), lambda i: (0,)),
-            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((c,), jnp.float32),
-            jax.ShapeDtypeStruct((c,), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
         ],
         interpret=_use_interpret(),
-    )(dy2d, x2d, mean, inv_std)
+    )(dy2d, x2d, mean.reshape(1, c), inv_std.reshape(1, c))
+    return db[0], dg[0]
 
 
 # ---------------------------------------------------------------------------
@@ -192,6 +196,18 @@ def _fbn_bwd(eps, res, cts):
 fused_batch_norm.defvjp(_fbn_fwd, _fbn_bwd)
 
 
+def require_single_device(n_devices: int) -> None:
+    """The one invariant every bn_impl='pallas' entry point must hold:
+    GSPMD has no partitioning rule for the stats kernels, so a
+    batch-sharded mesh would all-gather every BN layer's activations
+    (or fail to compile) and any measurement would be meaningless."""
+    if n_devices > 1:
+        raise SystemExit(
+            f"--bn-kernel pallas runs the single-device path only; this "
+            f"mesh has {n_devices} devices"
+        )
+
+
 def batch_norm_train(x, gamma, beta, eps):
     """Fused BN plus the (stop-gradiented) batch moments for running-
     stat updates."""
@@ -228,6 +244,9 @@ class TpuBatchNorm(nn.Module):
             y = (x.astype(jnp.float32) - ra_mean.value) * (inv * scale) + bias
             return y.astype(self.dtype)
         y, mean, var = batch_norm_train(x, scale, bias, self.epsilon)
+        # nn.BatchNorm returns self.dtype in BOTH modes; fused_batch_norm
+        # returned x.dtype, which differs whenever callers don't pre-cast.
+        y = y.astype(self.dtype)
         if not self.is_initializing():
             ra_mean.value = (
                 self.momentum * ra_mean.value + (1 - self.momentum) * mean
