@@ -764,10 +764,15 @@ class KubeWatch:
     """One streaming watch with transparent reconnect and 410 resume.
 
     Exposes the same queue interface as the in-memory ``Watch``
-    (``drain`` / ``next`` / ``stop``).  Maintains a mirror of the watched
-    collection so a compaction (410 Gone) resumes by re-listing and
-    emitting the *diff* as synthetic events — the informer on top never
-    notices.
+    (``drain`` / ``next`` / ``stop``).  Maintains a mirror of the
+    watched collection — **resourceVersions only, not objects**, so a
+    watch costs O(collection) keys rather than a full copy of every
+    object at cluster scale. A compaction (410 Gone) resumes by
+    re-listing and emitting the *diff* as synthetic events; ADDED and
+    MODIFIED carry the fresh objects from that list, while DELETED
+    carries a metadata-only tombstone (namespace/name/resourceVersion)
+    — the informer on top delivers the full last-known object from its
+    own cache, client-go's DeletedFinalStateUnknown discipline.
     """
 
     def __init__(self, server: KubeAPIServer, resource: str,
@@ -779,7 +784,8 @@ class KubeWatch:
         self._cond = threading.Condition()
         self._stopped = False
         self._rv = ""
-        self._mirror: dict[tuple[str, str], dict] = {}
+        # key -> object resourceVersion (see class docstring).
+        self._mirror: dict[tuple[str, str], str] = {}
         self._conn = None
         self._thread: Optional[threading.Thread] = None
         # Surfaced for tests/debugging: how many relists (410s) happened.
@@ -837,24 +843,43 @@ class KubeWatch:
         meta = obj.get("metadata") or {}
         return meta.get("namespace", ""), meta.get("name", "")
 
-    def _baseline(self, emit_diff: bool) -> None:
-        """Full list into the mirror; on resume (``emit_diff``) the diff
-        against the previous mirror becomes synthetic events."""
+    def _tombstone(self, key: tuple[str, str], old_rv: str) -> dict:
+        ns, name = key
+        rt = RESOURCES[self.resource]
+        return {
+            "apiVersion": rt.api_version,
+            "kind": rt.kind,
+            "metadata": {
+                "namespace": ns, "name": name, "resourceVersion": old_rv,
+            },
+        }
+
+    def _baseline(self, emit_diff: bool) -> list[dict]:
+        """Full (paginated) list into the rv mirror; on resume
+        (``emit_diff``) the diff against the previous mirror becomes
+        synthetic events. Returns the listed objects (the caller's
+        baseline snapshot) — they are not retained here."""
         items, rv = self._server.list_with_rv(self.resource, self.namespace)
-        fresh = {self._key(o): o for o in items}
+        fresh = {
+            self._key(o): o["metadata"].get("resourceVersion", "")
+            for o in items
+        }
         if emit_diff:
-            for key, obj in fresh.items():
-                old = self._mirror.get(key)
-                if old is None:
+            for obj in items:
+                old_rv = self._mirror.get(self._key(obj))
+                if old_rv is None:
                     self._deliver(WatchEvent(ADDED, self.resource, obj))
-                elif (old["metadata"].get("resourceVersion")
-                      != obj["metadata"].get("resourceVersion")):
+                elif old_rv != obj["metadata"].get("resourceVersion"):
                     self._deliver(WatchEvent(MODIFIED, self.resource, obj))
-            for key, obj in self._mirror.items():
+            for key, old_rv in self._mirror.items():
                 if key not in fresh:
-                    self._deliver(WatchEvent(DELETED, self.resource, obj))
+                    self._deliver(WatchEvent(
+                        DELETED, self.resource,
+                        self._tombstone(key, old_rv),
+                    ))
         self._mirror = fresh
         self._rv = rv
+        return items
 
     def _open_stream(self):
         """Open the chunked watch request; returns (conn, resp)."""
@@ -895,20 +920,16 @@ class KubeWatch:
         """Baseline list + first stream, synchronously, then the reader
         thread takes over. Guarantees the stream covers everything after
         the caller's next ``list()``."""
-        import copy
-
-        self._baseline(emit_diff=False)
+        items = self._baseline(emit_diff=False)
         try:
             self._conn, resp = self._open_stream()
         except _Gone:
             # Pathological but possible: compaction between list and watch.
             self.relist_count += 1
-            self._baseline(emit_diff=True)
+            items = self._baseline(emit_diff=True)
             self._conn, resp = self._open_stream()
         # After this point only the reader thread touches the mirror.
-        self._baseline_snapshot = [
-            copy.deepcopy(o) for o in self._mirror.values()
-        ]
+        self._baseline_snapshot = items
         self._thread = threading.Thread(
             target=self._run, args=(resp,),
             name=f"kubewatch-{self.resource}", daemon=True,
@@ -983,7 +1004,9 @@ class KubeWatch:
             if etype == DELETED:
                 self._mirror.pop(key, None)
             else:
-                self._mirror[key] = obj
+                self._mirror[key] = (obj.get("metadata") or {}).get(
+                    "resourceVersion", ""
+                )
             self._deliver(WatchEvent(etype, self.resource, obj))
         # Clean EOF: server closed (timeoutSeconds rollover); reconnect
         # from the last seen rv.

@@ -403,6 +403,55 @@ class TestRealApiserverBehaviors:
         assert kube.throttle_wait == 0.0
 
 
+class TestWatchMirrorFootprint:
+    def test_mirror_holds_rvs_not_objects(self, kube):
+        """The per-watch mirror must cost O(keys), not a full copy of
+        every object — at cluster scale the old full-object mirror was
+        memory-proportional to the collection."""
+        for i in range(5):
+            kube.create("pods", pod(f"m{i}"))
+        w = kube.watch("pods")
+        try:
+            assert len(w.baseline()) == 5
+            assert all(isinstance(v, str) for v in w._mirror.values())
+            kube.create("pods", pod("late"))
+            wait_for(
+                lambda: ("default", "late") in w._mirror,
+                msg="stream updates the rv mirror",
+            )
+            assert isinstance(w._mirror[("default", "late")], str)
+        finally:
+            w.stop()
+
+    def test_resume_deletion_emits_metadata_tombstone(self, kube):
+        """A deletion discovered via relist (not the stream) surfaces as
+        a metadata-only tombstone: the informer above fills in the full
+        last-known object from its own cache (DeletedFinalStateUnknown
+        discipline), so the watch never needs to retain objects."""
+        from mpi_operator_tpu.runtime.kube import KubeWatch
+
+        kube.create("pods", pod("p1"))
+        # Threadless watch (no _open): the test owns the mirror, so the
+        # relist diff is driven deterministically with no reader-thread
+        # race.
+        w = KubeWatch(kube, "pods", None)
+        w._baseline(emit_diff=False)
+        assert ("default", "p1") in w._mirror
+        # Simulate a compaction window: the object vanished while the
+        # stream was blind, so only the relist diff can see it.
+        w._mirror[("default", "ghost")] = "7"
+        w._baseline(emit_diff=True)
+        dels = [e for e in w.drain() if e.type == DELETED]
+        assert len(dels) == 1
+        obj = dels[0].object
+        assert obj["kind"] == "Pod"
+        assert obj["metadata"] == {
+            "namespace": "default", "name": "ghost",
+            "resourceVersion": "7",
+        }
+        assert "spec" not in obj  # metadata-only by design
+
+
 class TestKubeconfig:
     def test_parse_token_and_inline_ca(self, tmp_path):
         import base64
