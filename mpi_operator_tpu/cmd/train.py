@@ -261,20 +261,36 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
     dp = sizes.get("dp", 1)
     fsdp = sizes.get("fsdp", 1)
     tp = sizes.get("tp", 1)
+    sp = sizes.get("sp", 1)
     unsupported = [a for a, n in sizes.items()
-                   if a not in ("dp", "fsdp", "pp", "tp") and n > 1]
+                   if a not in ("dp", "fsdp", "pp", "tp", "sp") and n > 1]
     if unsupported:
         raise SystemExit(
-            f"pp meshes compose with dp, fsdp, and tp; {unsupported} "
-            f"would silently replicate work/params (sp is not wired "
-            f"through the pipelined llama — ring/ulysses own it)"
+            f"pp meshes compose with dp, fsdp, tp, and sp (ring); "
+            f"{unsupported} would silently replicate work/params"
         )
+    if sp > 1:
+        if args.sequence_parallel != "ring":
+            raise SystemExit(
+                "pp x sp runs the ppermute ring only (ulysses' "
+                "all-to-alls are not wired through the pipeline); use "
+                "--sequence-parallel ring"
+            )
+        if args.zigzag_ring:
+            raise SystemExit(
+                "--zigzag-ring is not wired through the pipeline (the "
+                "global zigzag permutation spans the stage boundary)"
+            )
+        if args.seq_len % sp:
+            raise SystemExit(
+                f"--seq-len {args.seq_len} not divisible by sp={sp}"
+            )
     if args.data:
         raise SystemExit(
             "--data is not wired through the pipelined llama workload "
             "yet; drop --data or train without pp"
         )
-    cfg = llama_config_from_args(args, sp=1)  # flash attention in stages
+    cfg = llama_config_from_args(args, sp=sp)  # ring in stages when sp>1
     if args.grad_accum > 1:
         raise SystemExit(
             "--grad-accum with a pp mesh is redundant: raise the "
@@ -337,10 +353,8 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
             f"{dp * fsdp} (microbatch rows shard over both)"
         )
 
-    model = lib.Llama(cfg)  # plain structure, used for init only
-    params0 = lib.init_params(model, jax.random.PRNGKey(args.seed))
     params = pp_lib.shard_pp_params(
-        pp_lib.pp_params_from_init(params0, cfg, pp), mesh
+        pp_lib.init_pp_params(cfg, pp, jax.random.PRNGKey(args.seed)), mesh
     )
     # Moments shard like the stage-stacked blocks; counters replicate.
     opt_state = pp_lib.shard_pp_opt_state(optimizer.init(params), mesh)
@@ -351,6 +365,7 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
             jnp.int32,
         ),
         mesh,
+        sequence_axis=1 if sp > 1 else None,
     )
     raw_step = jax.jit(
         pp_lib.make_pp_train_step(cfg, mesh, optimizer, mb),

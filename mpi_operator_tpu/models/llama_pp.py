@@ -20,15 +20,19 @@ The reference delegates pipelining to user MPI programs entirely
 equivalent, built as pure SPMD collectives.
 
 Tensor parallelism composes too: the pipeline's shard_map is manual
-over pp/dp/fsdp only and leaves ``tp`` an AUTO axis, so GSPMD keeps
+over pp/dp/fsdp/sp only and leaves ``tp`` an AUTO axis, so GSPMD keeps
 inserting the Megatron column/row collectives inside each stage while
 activations ppermute between stages (kernel output features shard over
-tp, ``_block_leaf_placement``).
+tp, ``_block_leaf_placement``). Sequence parallelism composes as well:
+with ``attention_impl='ring'`` the stages run the per-shard ppermute
+ring over the manual sp axis (global RoPE positions derived from the
+shard index) — dp x fsdp x tp x sp x pp in one train step.
 
 Restrictions: dense Llama only (MoE routes tokens through an ep
-all-to-all that would fight the stage ppermute), flash or dense
-attention inside stages (ring/ulysses own sp; pp x sp composition is
-not wired), ``n_layers`` must divide by the pp size, and fsdp sharding
+all-to-all that would fight the stage ppermute), flash/dense/ring
+attention inside stages (ulysses' all-to-alls and the zigzag ring
+layout are not wired through the pipeline), ``n_layers`` must divide
+by the pp size, and fsdp sharding
 covers the blocks (embed/head replicate). Checkpoints hold the
 stage-stacked [P, L/P, ...] layout: resume on the same pp size is
 shape-identical; resuming onto a DIFFERENT pp size needs a restack
@@ -41,7 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import DP, FSDP, PP, TP
+from ..parallel.mesh import DP, FSDP, PP, SP, TP
 from ..parallel.pipeline import microbatch, pipeline, unmicrobatch
 from .llama import Block, LlamaConfig, RMSNorm, remat_policy_for
 
@@ -127,6 +131,20 @@ def restack_block_params(blocks, n_stages_new: int):
     return jax.tree_util.tree_map(re, blocks)
 
 
+def init_pp_params(cfg: LlamaConfig, n_stages: int, rng):
+    """Fresh pipelined params for ``cfg``. Inits through a flash-
+    attention variant — param shapes don't depend on the attention
+    impl, and tracing the ring at init would demand a bound sp axis
+    the init-time forward doesn't have (the mirror image of the
+    'ring-shard' replace inside make_pp_loss_fn)."""
+    import dataclasses
+
+    from .llama import Llama, init_params
+
+    model = Llama(dataclasses.replace(cfg, attention_impl="flash"))
+    return pp_params_from_init(init_params(model, rng), cfg, n_stages)
+
+
 def pp_params_from_init(params, cfg: LlamaConfig, n_stages: int):
     """Regroup a standard init into the pipelined layout:
     {embed, blocks (stage-stacked), final_norm, lm_head}."""
@@ -194,29 +212,62 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
     ``cfg.remat`` (each layer inside a stage is checkpointed)."""
     if cfg.is_moe:
         raise ValueError("pipelined Llama supports dense configs only")
-    if cfg.attention_impl not in ("flash", "dense"):
+    if cfg.attention_impl not in ("flash", "dense", "ring"):
         raise ValueError(
-            f"pipelined Llama runs flash/dense attention inside stages, "
+            f"pipelined Llama runs flash/dense attention inside stages "
+            f"(or the ppermute ring when the mesh has sp), "
             f"not {cfg.attention_impl!r}"
         )
-    block = Block(cfg)
     names = mesh.axis_names
     fsdp = _fsdp_size(mesh) > 1
     tp = _axis_size(mesh, TP) > 1
+    sp = _axis_size(mesh, SP)
+    if cfg.attention_impl == "ring":
+        if sp <= 1:
+            raise ValueError(
+                "attention_impl='ring' in the pipeline needs an sp mesh "
+                "axis of size > 1"
+            )
+        if cfg.zigzag_ring:
+            raise ValueError(
+                "zigzag ring is not wired through the pipeline (the "
+                "global zigzag permutation spans the stage boundary); "
+                "use the contiguous ring"
+            )
+        # The stages run inside a region that is ALSO manual over sp, so
+        # the Block's attention must call the per-shard ring, not wrap
+        # its own shard_map.
+        import dataclasses as _dc
+
+        block = Block(_dc.replace(cfg, attention_impl="ring-shard"))
+    elif sp > 1:
+        raise ValueError(
+            f"the mesh has sp={sp} but attention_impl={cfg.attention_impl!r}"
+            f" computes shard-local attention — each sequence shard would "
+            f"silently attend only to itself; use attention_impl='ring'"
+        )
+    else:
+        block = Block(cfg)
     # Microbatch rows shard over every batch axis (dp AND fsdp — the
     # same layout shard_batch produces); leaving fsdp off forces XLA to
     # replicate-and-repartition activations at the shard_map boundary.
     batch_axes = tuple(a for a in (DP, FSDP) if a in names)
-    state_spec = P(batch_axes if batch_axes else None, None, None)
+    # With a ring, the sequence dim of one microbatch [mb, S, D] is
+    # manual over sp too.
+    seq_axis = SP if sp > 1 else None
+    state_spec = P(batch_axes if batch_axes else None, seq_axis, None)
     # tp stays an AUTO axis: the pipeline shard_map is manual over
-    # pp/dp/fsdp only, so GSPMD keeps inserting the tensor-parallel
+    # pp/dp/fsdp/sp only, so GSPMD keeps inserting the tensor-parallel
     # collectives (Megatron column/row splits) inside each stage.
     manual = frozenset(a for a in names if a != TP) if tp else None
 
     def stage_fn(stage_params, h):
-        positions = jnp.broadcast_to(
-            jnp.arange(h.shape[1]), h.shape[:2]
-        )
+        local = jnp.arange(h.shape[1])
+        if sp > 1:
+            # h carries the LOCAL sequence shard (contiguous ring
+            # layout): RoPE needs the global positions.
+            local = jax.lax.axis_index(SP) * h.shape[1] + local
+        positions = jnp.broadcast_to(local, h.shape[:2])
 
         def layer(carry, p_layer):
             def run(carry):

@@ -191,6 +191,62 @@ class TestPipelinedLlama:
                 losses.append(float(loss))
         assert losses[-1] < losses[0]
 
+    def test_sp_pp_ring_loss_matches_plain(self, setup):
+        """pp x sp: stages run the per-shard ppermute ring over a manual
+        sp axis (global RoPE positions from the shard index) — same loss
+        as the plain full-sequence model."""
+        cfg, model, params, tokens = setup
+        l_plain = float(llama_lib.loss_fn(model, params, tokens))
+        mesh = create_mesh(dp=2, sp=2, pp=2)
+        cfg_ring = llama_lib.tiny(n_layers=4, attention_impl="ring")
+        pp_params = pp_lib.shard_pp_params(
+            pp_lib.pp_params_from_init(params, cfg_ring, 2), mesh
+        )
+        loss_fn = pp_lib.make_pp_loss_fn(cfg_ring, mesh, microbatch_size=2)
+        with mesh:
+            l_pp = float(jax.jit(loss_fn)(
+                pp_params, shard_batch(tokens, mesh, sequence_axis=1)
+            ))
+        np.testing.assert_allclose(l_plain, l_pp, rtol=1e-4)
+
+    def test_sp_tp_pp_gradients_match_plain(self, setup):
+        """Ring over manual sp, tp auto, pp stages — gradients equal the
+        plain model's (the ring's custom VJP composes with the pipeline
+        scan's transpose)."""
+        cfg, model, params, tokens = setup
+        g_plain = jax.grad(
+            lambda p: llama_lib.loss_fn(model, p, tokens)
+        )(params)
+        mesh = create_mesh(sp=2, tp=2, pp=2)
+        cfg_ring = llama_lib.tiny(n_layers=4, attention_impl="ring")
+        pp_params = pp_lib.shard_pp_params(
+            pp_lib.pp_params_from_init(params, cfg_ring, 2), mesh
+        )
+        loss_fn = pp_lib.make_pp_loss_fn(cfg_ring, mesh, microbatch_size=4)
+        with mesh:
+            g_pp = jax.jit(jax.grad(loss_fn))(
+                pp_params, shard_batch(tokens, mesh, sequence_axis=1)
+            )
+        stacked_plain = pp_lib.stack_block_params(g_plain, cfg.n_layers, 2)
+        for a, b in zip(jax.tree_util.tree_leaves(stacked_plain),
+                        jax.tree_util.tree_leaves(g_pp["blocks"])):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-4
+            )
+
+    def test_sp_mesh_requires_ring(self):
+        """A local-attention impl on an sp mesh would silently attend
+        shard-locally — rejected loudly."""
+        mesh = create_mesh(dp=2, sp=2, pp=2)
+        cfg = llama_lib.tiny(n_layers=4, attention_impl="flash")
+        with pytest.raises(ValueError, match="attend only to itself"):
+            pp_lib.make_pp_loss_fn(cfg, mesh, microbatch_size=2)
+        cfg_z = llama_lib.tiny(
+            n_layers=4, attention_impl="ring", zigzag_ring=True
+        )
+        with pytest.raises(ValueError, match="zigzag"):
+            pp_lib.make_pp_loss_fn(cfg_z, mesh, microbatch_size=2)
+
     def test_params_spec_rejected_without_pp_axis(self):
         from jax.sharding import PartitionSpec as P
 
@@ -271,19 +327,33 @@ class TestTrainerPP:
             ])
 
     def test_pp_rejects_other_parallel_axes(self):
-        # dp/fsdp/tp compose with pp; sp does not (ring/ulysses own it).
+        # dp/fsdp/tp/sp compose with pp; ep does not (MoE routes tokens
+        # through an all-to-all that would fight the stage ppermute).
         from mpi_operator_tpu.cmd import train as train_cmd
 
-        with pytest.raises(SystemExit, match="compose with dp, fsdp, and tp"):
+        with pytest.raises(SystemExit, match="compose with dp, fsdp, tp"):
             train_cmd.main([
                 "--model", "llama-tiny", "--steps", "1",
-                "--mesh", "sp=4,pp=2", "--seq-len", "16",
+                "--mesh", "ep=4,pp=2", "--seq-len", "16",
             ])
         # tp must divide the head counts (tiny has 4 q / 2 kv heads).
         with pytest.raises(SystemExit, match="divide by tp"):
             train_cmd.main([
                 "--model", "llama-tiny", "--steps", "1",
                 "--mesh", "tp=4,pp=2", "--seq-len", "16",
+            ])
+        # sp composes via the ring only; ulysses/zigzag fail loudly.
+        with pytest.raises(SystemExit, match="ring only"):
+            train_cmd.main([
+                "--model", "llama-tiny", "--steps", "1",
+                "--mesh", "sp=4,pp=2", "--seq-len", "16",
+                "--sequence-parallel", "ulysses",
+            ])
+        with pytest.raises(SystemExit, match="zigzag"):
+            train_cmd.main([
+                "--model", "llama-tiny", "--steps", "1",
+                "--mesh", "sp=4,pp=2", "--seq-len", "16",
+                "--sequence-parallel", "ring", "--zigzag-ring",
             ])
 
     def test_pp_rejects_data_flag(self, tmp_path):
