@@ -266,31 +266,29 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
                    if a not in ("dp", "fsdp", "pp", "tp", "sp") and n > 1]
     if unsupported:
         raise SystemExit(
-            f"pp meshes compose with dp, fsdp, tp, and sp (ring); "
-            f"{unsupported} would silently replicate work/params"
+            f"pp meshes compose with dp, fsdp, tp, and sp (ring or "
+            f"ulysses); {unsupported} would silently replicate "
+            f"work/params"
         )
     if sp > 1:
-        if args.sequence_parallel != "ring":
-            raise SystemExit(
-                "pp x sp runs the ppermute ring only (ulysses' "
-                "all-to-alls are not wired through the pipeline); use "
-                "--sequence-parallel ring"
-            )
-        if args.zigzag_ring:
-            raise SystemExit(
-                "--zigzag-ring is not wired through the pipeline (the "
-                "global zigzag permutation spans the stage boundary)"
-            )
         if args.seq_len % sp:
             raise SystemExit(
                 f"--seq-len {args.seq_len} not divisible by sp={sp}"
+            )
+        if (args.zigzag_ring and args.sequence_parallel == "ring"
+                and args.seq_len % (2 * sp)):
+            # ulysses ignores --zigzag-ring (llama_config_from_args
+            # forces it off), so the constraint only binds the ring.
+            raise SystemExit(
+                f"--zigzag-ring needs --seq-len divisible by 2*sp="
+                f"{2 * sp}"
             )
     if args.data:
         raise SystemExit(
             "--data is not wired through the pipelined llama workload "
             "yet; drop --data or train without pp"
         )
-    cfg = llama_config_from_args(args, sp=sp)  # ring in stages when sp>1
+    cfg = llama_config_from_args(args, sp=sp)  # ring/ulysses when sp>1
     if args.grad_accum > 1:
         raise SystemExit(
             "--grad-accum with a pp mesh is redundant: raise the "

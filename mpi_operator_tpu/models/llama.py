@@ -176,6 +176,10 @@ def remat_policy_for(cfg: "LlamaConfig"):
 def _use_zigzag(cfg: "LlamaConfig", mesh) -> bool:
     """The ONE decision for zigzag layout — the model-level permute and
     the per-layer ring call must always agree."""
+    if cfg.attention_impl == "ring-shard":
+        # Already inside a manual sp region (the pp×sp pipeline): the
+        # caller owns the global permute; the flag alone decides.
+        return cfg.zigzag_ring
     if not (cfg.attention_impl == "ring" and cfg.zigzag_ring and mesh is not None):
         return False
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))

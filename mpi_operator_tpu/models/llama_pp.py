@@ -24,15 +24,15 @@ over pp/dp/fsdp/sp only and leaves ``tp`` an AUTO axis, so GSPMD keeps
 inserting the Megatron column/row collectives inside each stage while
 activations ppermute between stages (kernel output features shard over
 tp, ``_block_leaf_placement``). Sequence parallelism composes as well:
-with ``attention_impl='ring'`` the stages run the per-shard ppermute
-ring over the manual sp axis (global RoPE positions derived from the
-shard index) — dp x fsdp x tp x sp x pp in one train step.
+with ``attention_impl='ring'`` (contiguous or zigzag layout — the
+global permute lives at the loss edges, outside the stages) or
+``'ulysses'`` (per-shard all-to-alls inside the manual region) the
+stages run the per-shard sp kernels with global RoPE positions derived
+from the shard index — dp x fsdp x tp x sp x pp in one train step.
 
 Restrictions: dense Llama only (MoE routes tokens through an ep
-all-to-all that would fight the stage ppermute), flash/dense/ring
-attention inside stages (ulysses' all-to-alls and the zigzag ring
-layout are not wired through the pipeline), ``n_layers`` must divide
-by the pp size, and fsdp sharding
+all-to-all that would fight the stage ppermute), ``n_layers`` must
+divide by the pp size, and fsdp sharding
 covers the blocks (embed/head replicate). Checkpoints hold the
 stage-stacked [P, L/P, ...] layout: resume on the same pp size is
 shape-identical; resuming onto a DIFFERENT pp size needs a restack
@@ -212,39 +212,45 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
     ``cfg.remat`` (each layer inside a stage is checkpointed)."""
     if cfg.is_moe:
         raise ValueError("pipelined Llama supports dense configs only")
-    if cfg.attention_impl not in ("flash", "dense", "ring"):
+    if cfg.attention_impl not in ("flash", "dense", "ring", "ulysses"):
         raise ValueError(
             f"pipelined Llama runs flash/dense attention inside stages "
-            f"(or the ppermute ring when the mesh has sp), "
-            f"not {cfg.attention_impl!r}"
+            f"(or the ppermute ring / Ulysses all-to-alls when the mesh "
+            f"has sp), not {cfg.attention_impl!r}"
         )
     names = mesh.axis_names
     fsdp = _fsdp_size(mesh) > 1
     tp = _axis_size(mesh, TP) > 1
     sp = _axis_size(mesh, SP)
-    if cfg.attention_impl == "ring":
+    zigzag = False
+    if cfg.attention_impl in ("ring", "ulysses"):
         if sp <= 1:
             raise ValueError(
-                "attention_impl='ring' in the pipeline needs an sp mesh "
-                "axis of size > 1"
+                f"attention_impl={cfg.attention_impl!r} in the pipeline "
+                f"needs an sp mesh axis of size > 1"
             )
-        if cfg.zigzag_ring:
-            raise ValueError(
-                "zigzag ring is not wired through the pipeline (the "
-                "global zigzag permutation spans the stage boundary); "
-                "use the contiguous ring"
-            )
+        if cfg.zigzag_ring and cfg.attention_impl == "ring":
+            # The real sequence is validated by zigzag_indices at trace
+            # time; this catches the config-level mismatch early.
+            if cfg.max_seq_len % (2 * sp):
+                raise ValueError(
+                    f"zigzag needs seq divisible by 2*sp={2 * sp}"
+                )
+            zigzag = True
         # The stages run inside a region that is ALSO manual over sp, so
-        # the Block's attention must call the per-shard ring, not wrap
-        # its own shard_map.
+        # the Block's attention must call the per-shard kernels, not
+        # wrap its own shard_map.
         import dataclasses as _dc
 
-        block = Block(_dc.replace(cfg, attention_impl="ring-shard"))
+        block = Block(_dc.replace(
+            cfg, attention_impl=cfg.attention_impl + "-shard"
+        ))
     elif sp > 1:
         raise ValueError(
             f"the mesh has sp={sp} but attention_impl={cfg.attention_impl!r}"
             f" computes shard-local attention — each sequence shard would "
-            f"silently attend only to itself; use attention_impl='ring'"
+            f"silently attend only to itself; use attention_impl='ring' "
+            f"or 'ulysses'"
         )
     else:
         block = Block(cfg)
@@ -262,11 +268,17 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
     manual = frozenset(a for a in names if a != TP) if tp else None
 
     def stage_fn(stage_params, h):
-        local = jnp.arange(h.shape[1])
         if sp > 1:
-            # h carries the LOCAL sequence shard (contiguous ring
-            # layout): RoPE needs the global positions.
-            local = jax.lax.axis_index(SP) * h.shape[1] + local
+            # h carries the LOCAL sequence shard: RoPE needs the global
+            # positions of its rows (contiguous run, or the two zigzag
+            # half-chunks — the same ids the ring uses for masking).
+            from ..ops.ring_attention import _shard_ids
+
+            local = _shard_ids(
+                jax.lax.axis_index(SP), sp, h.shape[1], zigzag
+            )
+        else:
+            local = jnp.arange(h.shape[1])
         positions = jnp.broadcast_to(local, h.shape[:2])
 
         def layer(carry, p_layer):
@@ -298,6 +310,15 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
     def loss_fn(params, tokens):
         emb = params["embed"]["embedding"]  # [V, D] f32
         h = emb[tokens].astype(cfg.dtype)
+        if zigzag:
+            # Permute ONCE at the model edges (GSPMD land, full S):
+            # device i of the ring ends up holding chunks i and 2n-1-i,
+            # balancing causal work; every non-attention op is pointwise
+            # over sequence, and the stages' _shard_ids agree.
+            from ..ops.ring_attention import zigzag_indices, zigzag_inverse
+
+            seq = tokens.shape[1]
+            h = h[:, jnp.asarray(zigzag_indices(seq, sp))]
         x = microbatch(h, microbatch_size)  # [M, mb, S, D]
         y = pipeline(
             stage_fn, params["blocks"], x, mesh, state_spec=state_spec,
@@ -307,6 +328,9 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
             manual_axes=manual,
         )
         h = unmicrobatch(y)
+        if zigzag:
+            # Natural order for the next-token shift in the loss.
+            h = h[:, jnp.asarray(zigzag_inverse(tokens.shape[1], sp))]
         h = RMSNorm(cfg.norm_eps).apply(
             {"params": params["final_norm"]}, h
         )

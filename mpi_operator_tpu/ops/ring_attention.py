@@ -255,12 +255,16 @@ def sp_attention(
         from .ulysses import ulysses_attention_shard_mapped
 
         return ulysses_attention_shard_mapped(q, k, v, mesh, causal=causal)
-    if impl == "ring-shard":
+    if impl in ("ring-shard", "ulysses-shard"):
         # The caller is ALREADY inside a manual region over sp (the
-        # pp×sp pipeline stages, llama_pp) — run the per-shard ring
+        # pp×sp pipeline stages, llama_pp) — run the per-shard kernels
         # directly; wrapping another shard_map here would be an illegal
         # nesting. No mesh needed: the sp axis is bound by the caller.
-        return ring_attention(q, k, v, SP, causal=causal, zigzag=zigzag)
+        if impl == "ring-shard":
+            return ring_attention(q, k, v, SP, causal=causal, zigzag=zigzag)
+        from .ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, SP, causal=causal)
     raise ValueError(
         f"unknown attention impl {impl!r}; want flash|dense|ring|ulysses"
     )
