@@ -283,11 +283,6 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
                 f"--zigzag-ring needs --seq-len divisible by 2*sp="
                 f"{2 * sp}"
             )
-    if args.data:
-        raise SystemExit(
-            "--data is not wired through the pipelined llama workload "
-            "yet; drop --data or train without pp"
-        )
     cfg = llama_config_from_args(args, sp=sp)  # ring/ulysses when sp>1
     if args.grad_accum > 1:
         raise SystemExit(
@@ -357,14 +352,20 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
     # Moments shard like the stage-stacked blocks; counters replicate.
     opt_state = pp_lib.shard_pp_opt_state(optimizer.init(params), mesh)
 
-    tokens = shard_batch(
-        jnp.asarray(
-            rng.randint(0, cfg.vocab_size, (global_batch, args.seq_len)),
-            jnp.int32,
-        ),
-        mesh,
-        sequence_axis=1 if sp > 1 else None,
-    )
+    if args.data:
+        tokens = None  # batch_fn supplies every step; skip the dead
+        # synthetic assembly + transfer
+    else:
+        tokens = shard_batch(
+            jnp.asarray(
+                rng.randint(
+                    0, cfg.vocab_size, (global_batch, args.seq_len)
+                ),
+                jnp.int32,
+            ),
+            mesh,
+            sequence_axis=1 if sp > 1 else None,
+        )
     raw_step = jax.jit(
         pp_lib.make_pp_train_step(cfg, mesh, optimizer, mb),
         donate_argnums=(0, 1),
@@ -376,13 +377,68 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
         )
         return {"params": params, "opt_state": opt_state}, loss
 
+    batch_fn = None
+    if args.data:
+        _, _, batch_fn = _token_stream(
+            args, mesh, cfg.vocab_size, global_batch,
+            1 if sp > 1 else None,
+        )
+
     return Workload(
         state={"params": params, "opt_state": opt_state},
         step_fn=step_fn,
         batch=(tokens,),
         examples_per_step=global_batch,
         mesh=mesh,
+        batch_fn=batch_fn,
     )
+
+
+def _token_stream(args, mesh, vocab: int, global_batch: int, seq_ax):
+    """(dataset, to_global, batch_fn) for a --data token stream:
+    Feistel-shuffled [B, S] rows, device_put with the mesh's batch spec
+    ([B, S] shards S over sp when the mesh has one). Shared by the
+    dense-llama and pipelined workloads; BERT layers its MLM masking on
+    top."""
+    import jax
+
+    from jax.sharding import NamedSharding
+
+    from ..data import TokenDataset
+    from ..parallel.sharding import batch_spec
+
+    ds = TokenDataset(args.data, args.seq_len, seed=args.seed)
+    sharding = NamedSharding(mesh, batch_spec(mesh, sequence_axis=seq_ax))
+
+    def to_global(rows, shd=sharding):
+        # Each process assembled exactly its rows (the Feistel order
+        # is stateless); single-process takes the device_put shortcut.
+        if jax.process_count() == 1:
+            return jax.device_put(rows, shd)
+        return jax.make_array_from_process_local_data(shd, rows)
+
+    def batch_fn(step: int) -> tuple:
+        import jax.numpy as jnp
+        import numpy as np
+
+        if jax.process_count() == 1:
+            rows = ds.batch(step, global_batch).astype(np.int64) % vocab
+            return (jax.device_put(jnp.asarray(rows, jnp.int32), sharding),)
+
+        def cb(index):
+            # The callback sees the exact [rows, seq] slice each local
+            # shard needs — correct under ANY sharding, including
+            # meshes that replicate the batch dim over pp/tp (where the
+            # even per-process split would under-supply rows).
+            lo, hi, _ = index[0].indices(global_batch)
+            r = ds.rows(step, global_batch, lo, hi).astype(np.int64) % vocab
+            return np.asarray(r[:, index[1]], np.int32)
+
+        return (jax.make_array_from_callback(
+            (global_batch, args.seq_len), sharding, cb
+        ),)
+
+    return ds, to_global, batch_fn
 
 
 def _mlm_positions_batch(rows, rand):
@@ -538,31 +594,21 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
     if args.data:
         from jax.sharding import NamedSharding
 
-        from ..data import TokenDataset
         from ..parallel.sharding import batch_spec
 
         is_bert = args.model.startswith("bert")
-        ds = TokenDataset(args.data, args.seq_len, seed=args.seed)
-        # [B, S] arrays shard S over sp; [B, P] prediction-slot arrays
-        # (positions layout) shard over batch only.
-        sharding = NamedSharding(mesh, batch_spec(mesh, sequence_axis=seq_ax))
+        ds, to_global, token_batch_fn = _token_stream(
+            args, mesh, cfg.vocab_size, global_batch, seq_ax
+        )
         sharding_rows = NamedSharding(mesh, batch_spec(mesh))
-        vocab = cfg.vocab_size
-
-        def to_global(rows, shd=sharding):
-            # Each process assembled exactly its rows (the Feistel order
-            # is stateless); single-process takes the device_put shortcut.
-            if jax.process_count() == 1:
-                return jax.device_put(rows, shd)
-            return jax.make_array_from_process_local_data(shd, rows)
 
         def batch_fn(step: int) -> tuple:
+            if not is_bert:
+                return token_batch_fn(step)
             pi, pc = jax.process_index(), jax.process_count()
             rows = ds.batch(
                 step, global_batch, process_index=pi, process_count=pc,
-            ).astype(np.int64) % vocab
-            if not is_bert:
-                return (to_global(jnp.asarray(rows, jnp.int32)),)
+            ).astype(np.int64) % cfg.vocab_size
             # MLM randomness: drawn for the GLOBAL batch and sliced to
             # this process's rows, so each global row's mask/positions
             # are pure in (seed, step, row) — identical across any

@@ -145,6 +145,32 @@ class TokenDataset:
         ]
         return np.stack(rows).astype(np.uint32)
 
+    def rows(self, step: int, global_batch: int, lo: int,
+             hi: int) -> np.ndarray:
+        """Global rows [lo, hi) of batch ``step`` — the primitive both
+        ``batch`` and sharding callbacks slice from (a mesh that
+        replicates the batch dim over pp/tp needs arbitrary row ranges,
+        not just the even process split)."""
+        if not 0 <= lo <= hi <= global_batch:
+            raise ValueError(
+                f"rows [{lo}, {hi}) outside global batch {global_batch}"
+            )
+        if hi == lo:
+            return np.empty((0, self.seq_len), dtype=np.uint32)
+        gstart = step * global_batch + lo
+        epoch, start = divmod(gstart, self.num_sequences)
+        # A batch can straddle epoch boundaries (several, if the corpus is
+        # smaller than the slice): walk them so every part uses its own
+        # epoch's permutation seed.
+        parts = []
+        remaining = hi - lo
+        while remaining > 0:
+            take = min(remaining, self.num_sequences - start)
+            parts.append(self.fill(epoch, start, take))
+            remaining -= take
+            epoch, start = epoch + 1, 0
+        return np.concatenate(parts) if len(parts) > 1 else parts[0]
+
     def batch(self, step: int, global_batch: int,
               *, process_index: int = 0, process_count: int = 1) -> np.ndarray:
         """This process's rows of global batch ``step``.
@@ -161,19 +187,10 @@ class TokenDataset:
                 f"{process_count} processes"
             )
         per_proc = global_batch // process_count
-        gstart = step * global_batch + process_index * per_proc
-        epoch, start = divmod(gstart, self.num_sequences)
-        # A batch can straddle epoch boundaries (several, if the corpus is
-        # smaller than the slice): walk them so every part uses its own
-        # epoch's permutation seed.
-        parts = []
-        remaining = per_proc
-        while remaining > 0:
-            take = min(remaining, self.num_sequences - start)
-            parts.append(self.fill(epoch, start, take))
-            remaining -= take
-            epoch, start = epoch + 1, 0
-        return np.concatenate(parts) if len(parts) > 1 else parts[0]
+        return self.rows(
+            step, global_batch,
+            process_index * per_proc, (process_index + 1) * per_proc,
+        )
 
 
 class Prefetcher:

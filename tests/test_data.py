@@ -77,6 +77,24 @@ class TestTokenDataset:
         ]
         np.testing.assert_array_equal(np.concatenate(parts), full)
 
+    def test_rows_primitive_slices_the_global_batch(self, token_file):
+        """ds.rows(step, B, lo, hi) is the slicing primitive sharding
+        callbacks use — any [lo, hi) must equal that slice of the full
+        batch, including ranges no process split produces and epoch
+        straddles."""
+        ds = TokenDataset(token_file, 16, use_native=False)
+        full = ds.batch(2, 8)
+        for lo, hi in [(0, 8), (3, 5), (1, 7), (0, 0), (7, 8)]:
+            np.testing.assert_array_equal(
+                ds.rows(2, 8, lo, hi), full[lo:hi]
+            )
+        # Straddling an epoch boundary (64 sequences; step 7 of B=12
+        # covers rows 84..96 -> epochs 1 and 2 for the tail range).
+        full2 = ds.batch(7, 12)
+        np.testing.assert_array_equal(ds.rows(7, 12, 5, 12), full2[5:12])
+        with pytest.raises(ValueError, match="outside"):
+            ds.rows(0, 8, 2, 9)
+
     def test_epoch_boundary_reshuffles(self, token_file):
         ds = TokenDataset(token_file, 16, use_native=False)
         # 64 sequences / batch 8 -> 8 steps per epoch.

@@ -368,16 +368,27 @@ class TestTrainerPP:
                 "--sequence-parallel", "ring", "--zigzag-ring",
             ])
 
-    def test_pp_rejects_data_flag(self, tmp_path):
-        from mpi_operator_tpu.cmd import train as train_cmd
+    def test_pp_trains_from_token_file(self, capsys, tmp_path):
+        """Real-corpus training through the pipeline: the Feistel token
+        stream feeds the pp step a fresh batch every step."""
+        import numpy as np
 
-        data = tmp_path / "toks.bin"
-        data.write_bytes(b"\x00" * 4096)
-        with pytest.raises(SystemExit, match="--data is not wired"):
-            train_cmd.main([
-                "--model", "llama-tiny", "--steps", "1",
-                "--mesh", "dp=4,pp=2", "--data", str(data), "--seq-len", "16",
-            ])
+        from mpi_operator_tpu.data import write_token_file
+        from tests.test_train import run_train
+
+        path = tmp_path / "corpus.bin"
+        write_token_file(
+            path, np.random.RandomState(0).randint(
+                0, 250, size=64 * 32).astype(np.uint32),
+        )
+        m = run_train(
+            capsys, "--model", "llama-tiny", "--n-layers", "4",
+            "--steps", "3", "--warmup", "1", "--mesh", "dp=4,pp=2",
+            "--global-batch", "8", "--seq-len", "16", "--log-every", "0",
+            "--data", str(path),
+        )
+        assert m["final_step"] == 3
+        assert np.isfinite(m["loss"])
 
     def test_default_microbatch_derivation_finds_divisor(self, capsys):
         # global 20 on pp=2: 20//(2*2)=5 is a divisor but must also be a
