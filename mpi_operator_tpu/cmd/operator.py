@@ -178,6 +178,23 @@ def run(argv=None) -> int:
     is_leader = metrics.new_gauge(
         "tpu_operator_is_leader", "1 if this replica is the leader", (), registry
     )
+    if hasattr(api, "retry_count"):
+        # REST-client flow control observability (client-go's
+        # rest_client_* metrics analog): monotonic totals mirrored from
+        # the client at scrape time.
+        rest_retries = metrics.new_counter(
+            "tpu_operator_rest_client_retries_total",
+            "requests retried after 429/transient failures", registry,
+        )
+        rest_throttle = metrics.new_counter(
+            "tpu_operator_rest_client_throttle_seconds_total",
+            "seconds spent waiting on the client-side QPS limiter",
+            registry,
+        )
+        registry.on_scrape(lambda: (
+            rest_retries.mirror_total(api.retry_count),
+            rest_throttle.mirror_total(round(api.throttle_wait, 3)),
+        ))
     controller = TPUJobController(
         api,
         namespace=args.namespace,

@@ -51,6 +51,15 @@ class Counter(_Metric):
         with self._lock:
             self._values[labels] = self._values.get(labels, 0.0) + amount
 
+    def mirror_total(self, value: float, *labels: str) -> None:
+        """Overwrite with an externally-accumulated monotonic total (a
+        counter whose source of truth lives elsewhere, e.g. the REST
+        client's retry count, mirrored on scrape). Never decreases —
+        counter semantics survive a racy double-set."""
+        with self._lock:
+            if value > self._values.get(labels, 0.0):
+                self._values[labels] = value
+
     def value(self, *labels: str) -> float:
         with self._lock:
             return self._values.get(labels, 0.0)
@@ -88,13 +97,25 @@ class _GaugeView:
 class Registry:
     def __init__(self):
         self._metrics: list[_Metric] = []
+        self._hooks: list = []
         self._lock = threading.Lock()
 
     def register(self, metric: _Metric) -> None:
         with self._lock:
             self._metrics.append(metric)
 
+    def on_scrape(self, fn) -> None:
+        """Run ``fn`` at the top of every ``expose`` — the pull-model
+        hook for values that live outside the metric objects (e.g. the
+        REST client's retry/throttle counters)."""
+        with self._lock:
+            self._hooks.append(fn)
+
     def expose(self) -> str:
+        with self._lock:
+            hooks = list(self._hooks)
+        for fn in hooks:  # outside the lock: hooks may set() metrics
+            fn()
         with self._lock:
             return "\n".join(m.expose() for m in self._metrics) + "\n"
 

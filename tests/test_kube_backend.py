@@ -638,6 +638,33 @@ def _has_condition(kube, name, ctype) -> bool:
     )
 
 
+class TestRestClientMetrics:
+    def test_scrape_reflects_retry_and_throttle_counters(self, frontend):
+        """The /metrics path surfaces the REST client's flow-control
+        counters (client-go rest_client_* analog) via the registry's
+        on-scrape hook — values refresh at scrape time."""
+        from mpi_operator_tpu.utils import metrics as metrics_lib
+
+        kube = KubeAPIServer(RestConfig(host=frontend.url))
+        registry = metrics_lib.Registry()
+        c = metrics_lib.new_counter(
+            "tpu_operator_rest_client_retries_total", "retries", registry,
+        )
+        registry.on_scrape(lambda: c.mirror_total(kube.retry_count))
+        try:
+            exposed = registry.expose()
+            assert "retries_total 0" in exposed
+            # *_total series carry counter semantics, not gauge.
+            assert "# TYPE tpu_operator_rest_client_retries_total counter" \
+                in exposed
+            frontend.throttle_429 = 2
+            kube.list("pods")
+            exposed = registry.expose()
+            assert "tpu_operator_rest_client_retries_total 2" in exposed
+        finally:
+            kube.close()
+
+
 class TestOperatorProcessOverRest:
     """``--backend kube --kubeconfig …``: the whole operator process —
     flag parsing, kubeconfig loading, REST clientset, informers,
