@@ -218,8 +218,9 @@ def _resnet_workload(args, mesh, n_devices: int) -> Workload:
     )
 
 
-def _is_dense_llama(model: str) -> bool:
-    return model in ("llama3-8b", "llama-tiny")
+def _is_llama_family(model: str) -> bool:
+    return model in ("llama3-8b", "llama-tiny", "mixtral-8x7b",
+                     "llama-moe-tiny")
 
 
 def llama_config_from_args(args, sp: int):
@@ -262,13 +263,15 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
     fsdp = sizes.get("fsdp", 1)
     tp = sizes.get("tp", 1)
     sp = sizes.get("sp", 1)
+    ep = sizes.get("ep", 1)
     unsupported = [a for a, n in sizes.items()
-                   if a not in ("dp", "fsdp", "pp", "tp", "sp") and n > 1]
+                   if a not in ("dp", "fsdp", "pp", "tp", "sp", "ep")
+                   and n > 1]
     if unsupported:
         raise SystemExit(
-            f"pp meshes compose with dp, fsdp, tp, and sp (ring or "
-            f"ulysses); {unsupported} would silently replicate "
-            f"work/params"
+            f"pp meshes compose with dp, fsdp, tp, sp (ring or "
+            f"ulysses), and ep (MoE); {unsupported} would silently "
+            f"replicate work/params"
         )
     if sp > 1:
         if args.seq_len % sp:
@@ -284,6 +287,21 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
                 f"{2 * sp}"
             )
     cfg = llama_config_from_args(args, sp=sp)  # ring/ulysses when sp>1
+    if cfg.is_moe:
+        if fsdp > 1 or sp > 1:
+            raise SystemExit(
+                "pipelined MoE composes with dp/tp/ep; fsdp (ZeRO-3 "
+                "gathers assume dense kernels) and sp (routing capacity "
+                "is per sequence) do not apply"
+            )
+        if ep > 1 and cfg.n_experts % ep:
+            raise SystemExit(
+                f"{cfg.n_experts} experts not divisible by ep={ep}"
+            )
+    elif ep > 1:
+        raise SystemExit(
+            f"--mesh ep={ep} needs an MoE model; {args.model} is dense"
+        )
     if args.grad_accum > 1:
         raise SystemExit(
             "--grad-accum with a pp mesh is redundant: raise the "
@@ -668,12 +686,13 @@ def main(argv=None) -> int:
 
     devices = jax.devices()
     mesh_spec = parse_mesh_spec(args.mesh)
-    if mesh_spec.get("pp", 1) != 1 and not _is_dense_llama(args.model):
-        # Only the dense Llama workload consumes pp (llama_pp.py); other
-        # stock workloads would silently replicate work. Refuse loudly.
+    if mesh_spec.get("pp", 1) != 1 and not _is_llama_family(args.model):
+        # Only the Llama-family workload consumes pp (llama_pp.py);
+        # other stock workloads would silently replicate work.
         raise SystemExit(
-            "--mesh pp is wired for dense llama models only; use the "
-            "parallel.run_pipeline API for custom stages, or drop pp"
+            "--mesh pp is wired for dense llama and MoE models only; "
+            "use the parallel.run_pipeline API for custom stages, or "
+            "drop pp"
         )
     mesh = create_mesh(**mesh_spec)
     log.info(

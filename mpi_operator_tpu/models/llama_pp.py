@@ -30,9 +30,16 @@ global permute lives at the loss edges, outside the stages) or
 stages run the per-shard sp kernels with global RoPE positions derived
 from the shard index — dp x fsdp x tp x sp x pp in one train step.
 
-Restrictions: dense Llama only (MoE routes tokens through an ep
-all-to-all that would fight the stage ppermute), ``n_layers`` must
-divide by the pp size, and fsdp sharding
+MoE pipelines too: ``ep`` rides as another AUTO axis (expert
+dispatch/combine all-to-alls stay GSPMD-derived inside the stages),
+and the router load-balance loss flows through the pipeline's
+``with_aux`` accumulator — per-row routing makes the pipelined loss,
+aux and capacity drops included, exactly the plain model's. MoE
+composes with dp/tp/ep (fsdp's dense-kernel gather and sp's
+per-sequence capacity do not apply).
+
+Restrictions: ``n_layers`` must divide by the pp size, and fsdp
+sharding (dense models)
 covers the blocks (embed/head replicate). Checkpoints hold the
 stage-stacked [P, L/P, ...] layout: resume on the same pp size is
 shape-identical; resuming onto a DIFFERENT pp size needs a restack
@@ -45,7 +52,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import DP, FSDP, PP, SP, TP
+from ..parallel.mesh import DP, EP, FSDP, PP, SP, TP
 from ..parallel.pipeline import microbatch, pipeline, unmicrobatch
 from .llama import Block, LlamaConfig, RMSNorm, remat_policy_for
 
@@ -158,19 +165,49 @@ def pp_params_from_init(params, cfg: LlamaConfig, n_stages: int):
     return out
 
 
+def _on_mesh(spec: P, mesh) -> P:
+    """Drop spec axes the mesh does not carry (e.g. a pp-stacked
+    checkpoint placed on a no-pp mesh for the sequential fallback)."""
+    return P(*(
+        a if (a is None or a in mesh.axis_names) else None for a in spec
+    ))
+
+
+def _placement_with_path(path, leaf, fsdp: bool, tp: bool, ep: bool) -> P:
+    """Storage spec for one stacked block leaf, MoE-aware: expert
+    kernels [P, L/P, E, d_in, d_out] put the expert dim over ep and the
+    hidden (F) dim over tp (both AUTO axes inside the pipeline); the
+    tiny router replicates; dense leaves fall through to
+    ``_block_leaf_placement``."""
+    ps = jax.tree_util.keystr(path)
+    if leaf.ndim == 5 and "expert_w" in ps:
+        spec = [PP, None, EP if ep else None, None, None]
+        if tp:
+            # F is d_out for wg/wu ([E, D, F]) and d_in for wd
+            # ([E, F, D]) — mirror moe.param_sharding_rules.
+            spec[3 if "expert_wd" in ps else 4] = TP
+        return P(*spec)
+    if "router" in ps:
+        return P(PP)
+    return _block_leaf_placement(leaf, fsdp, tp)
+
+
 def shard_pp_params(pp_params, mesh):
     """Blocks shard over pp on the stage dim — and, when the mesh has an
     fsdp axis, over fsdp on the first weight dim (ZeRO-3 storage; the
-    stage loop all-gathers one layer at a time), and over tp on kernel
-    output features (GSPMD-managed inside the stages). Embed/norm/head
-    replicate: they are used on every stage and are a sliver of the
-    block weights for deep models."""
+    stage loop all-gathers one layer at a time), over tp on kernel
+    output features, and over ep on the expert dim (both GSPMD-managed
+    inside the stages). Embed/norm/head replicate: they are used on
+    every stage and are a sliver of the block weights for deep models."""
     fsdp = _fsdp_size(mesh) > 1
     tp = _axis_size(mesh, TP) > 1
-    blocks = jax.tree_util.tree_map(
-        lambda w: jax.device_put(
+    ep = _axis_size(mesh, EP) > 1
+    blocks = jax.tree_util.tree_map_with_path(
+        lambda path, w: jax.device_put(
             w,
-            NamedSharding(mesh, _block_leaf_placement(w, fsdp, tp)),
+            NamedSharding(mesh, _on_mesh(
+                _placement_with_path(path, w, fsdp, tp, ep), mesh
+            )),
         ),
         pp_params["blocks"],
     )
@@ -195,23 +232,25 @@ def shard_pp_opt_state(opt_state, mesh):
     repl = NamedSharding(mesh, P())
 
     tp = _axis_size(mesh, TP) > 1
+    ep = _axis_size(mesh, EP) > 1
 
-    def place(w):
+    def place(path, w):
         if getattr(w, "ndim", 0) >= 3:
             return jax.device_put(
-                w, NamedSharding(mesh, _block_leaf_placement(w, fsdp, tp))
+                w,
+                NamedSharding(mesh, _on_mesh(
+                    _placement_with_path(path, w, fsdp, tp, ep), mesh
+                )),
             )
         return jax.device_put(w, repl)
 
-    return jax.tree_util.tree_map(place, opt_state)
+    return jax.tree_util.tree_map_with_path(place, opt_state)
 
 
 def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
     """Next-token CE with the blocks pipelined over pp. Params must be in
     the ``pp_params_from_init`` layout. Honors ``cfg.xent_chunk`` and
     ``cfg.remat`` (each layer inside a stage is checkpointed)."""
-    if cfg.is_moe:
-        raise ValueError("pipelined Llama supports dense configs only")
     if cfg.attention_impl not in ("flash", "dense", "ring", "ulysses"):
         raise ValueError(
             f"pipelined Llama runs flash/dense attention inside stages "
@@ -222,6 +261,19 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
     fsdp = _fsdp_size(mesh) > 1
     tp = _axis_size(mesh, TP) > 1
     sp = _axis_size(mesh, SP)
+    moe = cfg.is_moe
+    if moe and fsdp:
+        raise ValueError(
+            "pipelined MoE composes with dp/tp/ep, not fsdp — the ZeRO-3 "
+            "per-layer gather assumes dense [in, out] kernels, and the "
+            "expert dim wants ep"
+        )
+    if moe and sp > 1:
+        raise ValueError(
+            "pipelined MoE does not compose with sp: routing capacity is "
+            "per sequence, and a sequence shard would route against a "
+            "fraction of it"
+        )
     zigzag = False
     if cfg.attention_impl in ("ring", "ulysses"):
         if sp <= 1:
@@ -262,10 +314,12 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
     # manual over sp too.
     seq_axis = SP if sp > 1 else None
     state_spec = P(batch_axes if batch_axes else None, seq_axis, None)
-    # tp stays an AUTO axis: the pipeline shard_map is manual over
+    # tp and ep stay AUTO axes: the pipeline shard_map is manual over
     # pp/dp/fsdp/sp only, so GSPMD keeps inserting the tensor-parallel
-    # collectives (Megatron column/row splits) inside each stage.
-    manual = frozenset(a for a in names if a != TP) if tp else None
+    # collectives (Megatron column/row splits) and the expert
+    # dispatch/combine all-to-alls inside each stage.
+    auto = {a for a in (TP, EP) if _axis_size(mesh, a) > 1}
+    manual = frozenset(a for a in names if a not in auto) if auto else None
 
     def stage_fn(stage_params, h):
         if sp > 1:
@@ -282,7 +336,9 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
         positions = jnp.broadcast_to(local, h.shape[:2])
 
         def layer(carry, p_layer):
-            def run(carry):
+            h, aux_sum = carry
+
+            def run(h):
                 if fsdp:
                     # ZeRO-3 moment: materialize THIS layer's full
                     # weights from their fsdp shards; under remat the
@@ -297,15 +353,17 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
                     )
                 else:
                     p_full = p_layer
-                out, _aux = block.apply({"params": p_full}, carry, positions)
-                return out
+                return block.apply({"params": p_full}, h, positions)
 
             if cfg.remat:
                 run = jax.checkpoint(run, policy=remat_policy_for(cfg))
-            return run(carry), None
+            h, aux = run(h)
+            return (h, aux_sum + aux), None
 
-        h, _ = jax.lax.scan(layer, h, stage_params)
-        return h
+        (h, aux_sum), _ = jax.lax.scan(
+            layer, (h, jnp.zeros((), jnp.float32)), stage_params
+        )
+        return (h, aux_sum) if moe else h
 
     def loss_fn(params, tokens):
         emb = params["embed"]["embedding"]  # [V, D] f32
@@ -326,7 +384,21 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
                 _block_leaf_spec, params["blocks"]
             ) if fsdp else None,
             manual_axes=manual,
+            with_aux=moe,
         )
+        if moe:
+            y, aux_raw = y
+            # Raw sum over (microbatch, dp-shard) chunks of a per-chunk
+            # group MEAN — dividing by the chunk count recovers the
+            # full-batch mean the plain model computes (routing is
+            # per-row, so the numbers agree exactly). On a mesh with no
+            # pp axis, pipeline()'s sequential fallback runs each
+            # microbatch GLOBALLY (dp handled by GSPMD), so the chunk
+            # count is just M.
+            n_chunks = x.shape[0]
+            if PP in names:
+                n_chunks *= _axis_size(mesh, DP) * _axis_size(mesh, FSDP)
+            aux_total = aux_raw / n_chunks
         h = unmicrobatch(y)
         if zigzag:
             # Natural order for the next-token shift in the loss.
@@ -342,7 +414,10 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
         from ..ops.losses import lm_xent_chunked
 
         chunk = cfg.xent_chunk if cfg.xent_chunk > 0 else tokens.shape[1]
-        return lm_xent_chunked(h[:, :-1], w, tokens[:, 1:], chunk=chunk)
+        ce = lm_xent_chunked(h[:, :-1], w, tokens[:, 1:], chunk=chunk)
+        if moe:
+            return ce + cfg.router_aux_coef * aux_total
+        return ce
 
     return loss_fn
 

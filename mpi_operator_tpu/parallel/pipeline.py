@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from .mesh import PP
+from .mesh import DP, FSDP, PP
 
 
 def num_microbatches(global_batch: int, microbatch: int) -> int:
@@ -51,6 +51,7 @@ def pipeline(
     state_spec: Optional[P] = None,
     params_spec=None,
     manual_axes=None,
+    with_aux: bool = False,
 ):
     """Run ``fn`` as a P-stage pipeline over microbatched input.
 
@@ -76,8 +77,15 @@ def pipeline(
                    Megatron psums. Default: every mesh axis manual
                    (classic shard_map). Must include ``axis``, and
                    specs may only name manual axes.
+    with_aux:      ``fn`` returns ``(h, aux_scalar)``; bubble ticks'
+                   garbage aux is masked out, real (stage, microbatch)
+                   contributions sum across the schedule and the ring
+                   (every pair executes exactly once), and pipeline
+                   returns ``(outputs, aux_sum)`` — the MoE router
+                   load-balance channel.
 
-    Returns [M, mb, ...] outputs (replicated over ``axis``).
+    Returns [M, mb, ...] outputs (replicated over ``axis``), plus the
+    aux sum when ``with_aux``.
     """
     if axis not in mesh.axis_names:
         # No pp axis: run the stages sequentially (the pipeline of one).
@@ -92,10 +100,15 @@ def pipeline(
 
         def seq(h_all):
             n_stages = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+            aux_sum = jnp.float32(0.0)
             for i in range(n_stages):
                 stage = jax.tree_util.tree_map(lambda w: w[i], stage_params)
-                h_all = jax.vmap(lambda h: fn(stage, h))(h_all)
-            return h_all
+                if with_aux:
+                    h_all, aux = jax.vmap(lambda h: fn(stage, h))(h_all)
+                    aux_sum = aux_sum + jnp.sum(aux)
+                else:
+                    h_all = jax.vmap(lambda h: fn(stage, h))(h_all)
+            return (h_all, aux_sum) if with_aux else h_all
 
         return seq(x)
 
@@ -135,16 +148,26 @@ def pipeline(
         ticks = m + n - 1
         outputs = jnp.zeros_like(x_all)
         state = jnp.zeros_like(x_all[0])
+        aux_acc = jnp.zeros((), jnp.float32)
 
         def tick(carry, t):
-            state, outputs = carry
+            state, outputs, aux_acc = carry
             # Stage 0 injects microbatch t; later stages eat the permuted
             # activation from their predecessor.
             inj = jax.lax.dynamic_index_in_dim(
                 x_all, jnp.clip(t, 0, m - 1), axis=0, keepdims=False
             )
             h_in = jnp.where(i == 0, inj, state)
-            h_out = fn(params_me, h_in)
+            if with_aux:
+                h_out, aux_t = fn(params_me, h_in)
+                # Stage i computes microbatch t - i; bubble ticks chew
+                # garbage — their aux must not pollute the sum.
+                mine = t - i
+                aux_acc = aux_acc + jnp.where(
+                    (mine >= 0) & (mine < m), aux_t, 0.0
+                )
+            else:
+                h_out = fn(params_me, h_in)
             # Last stage banks microbatch t - (n-1) when it is real.
             mb_idx = t - (n - 1)
             valid_out = (i == n - 1) & (mb_idx >= 0)
@@ -154,15 +177,24 @@ def pipeline(
             outputs = jnp.where(valid_out, banked, outputs)
             perm = [(j, (j + 1) % n) for j in range(n)]
             state = jax.lax.ppermute(h_out, axis, perm)
-            return (state, outputs), None
+            return (state, outputs, aux_acc), None
 
-        (state, outputs), _ = jax.lax.scan(
-            tick, (state, outputs), jnp.arange(ticks)
+        (state, outputs, aux_acc), _ = jax.lax.scan(
+            tick, (state, outputs, aux_acc), jnp.arange(ticks)
         )
         # Only the last stage holds real outputs; replicate over the ring.
-        return jax.lax.psum(
+        outputs = jax.lax.psum(
             jnp.where(i == n - 1, outputs, jnp.zeros_like(outputs)), axis
         )
+        if with_aux:
+            # Every (stage, microbatch, batch-shard) triple ran exactly
+            # once somewhere: psum over the ring AND the manual batch
+            # axes yields the raw total — callers normalize by their
+            # chunk count (aux varies per dp/fsdp row shard, so leaving
+            # those out would emit a value shard_map cannot describe
+            # with a scalar out_spec).
+            return outputs, jax.lax.psum(aux_acc, aux_reduce)
+        return outputs
 
     kw = {}
     if manual_axes is not None:
@@ -173,11 +205,34 @@ def pipeline(
                 f"pipeline axis {axis!r}"
             )
         kw["axis_names"] = manual_axes
+    effective_manual = (
+        manual_axes if manual_axes is not None else frozenset(mesh.axis_names)
+    )
+    aux_reduce = tuple(
+        a for a in (axis, DP, FSDP)
+        if a in effective_manual and a in mesh.axis_names
+    )
+    if with_aux:
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        unreduced = [
+            a for a in effective_manual
+            if a in mesh.axis_names and a not in aux_reduce
+            and sizes[a] > 1
+        ]
+        if unreduced:
+            # A manual axis outside the reduce set would leave aux
+            # varying across shards while the scalar out_spec claims
+            # replication — silently wrong, so refuse.
+            raise ValueError(
+                f"with_aux reduces over {list(aux_reduce)}; manual "
+                f"axes {sorted(unreduced)} would hold divergent aux "
+                f"values (shard or drop them, or run without aux)"
+            )
     return shard_map(
         per_shard,
         mesh=mesh,
         in_specs=(params_spec, x_spec),
-        out_specs=x_spec,
+        out_specs=(x_spec, P()) if with_aux else x_spec,
         check_vma=False,  # fn may contain pallas kernels (see ring_attention)
         **kw,
     )(stage_params, x)

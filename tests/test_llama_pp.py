@@ -257,6 +257,100 @@ class TestPipelinedLlama:
             ))
         np.testing.assert_allclose(l_plain, l_pp, rtol=1e-4)
 
+    def test_moe_pp_loss_matches_plain(self):
+        """Pipelined MoE on dp x ep x pp: routing is per batch row, so
+        the pipelined loss — INCLUDING the router aux term and capacity
+        drops — equals the plain model's exactly (the aux channel rides
+        the pipeline's with_aux accumulator, normalized by chunk
+        count)."""
+        cfg = llama_lib.tiny_moe(n_layers=4)
+        model = llama_lib.Llama(cfg)
+        params = llama_lib.init_params(model, jax.random.PRNGKey(0))
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16)),
+            jnp.int32,
+        )
+        l_plain = float(llama_lib.loss_fn(model, params, tokens))
+        mesh = create_mesh(dp=2, ep=2, pp=2)
+        pp_params = pp_lib.shard_pp_params(
+            pp_lib.pp_params_from_init(params, cfg, 2), mesh
+        )
+        wg = jax.tree_util.tree_leaves_with_path(pp_params["blocks"])
+        expert_leaves = [
+            (jax.tree_util.keystr(p), l.sharding.spec)
+            for p, l in wg if "expert_wg" in jax.tree_util.keystr(p)
+        ]
+        assert expert_leaves and all(
+            "ep" in str(spec) for _, spec in expert_leaves
+        )
+        loss_fn = pp_lib.make_pp_loss_fn(cfg, mesh, microbatch_size=2)
+        with mesh:
+            l_pp = float(jax.jit(loss_fn)(
+                pp_params, shard_batch(tokens, mesh)
+            ))
+        np.testing.assert_allclose(l_pp, l_plain, rtol=1e-5)
+
+    def test_moe_pp_gradients_match_plain(self):
+        cfg = llama_lib.tiny_moe(n_layers=4)
+        model = llama_lib.Llama(cfg)
+        params = llama_lib.init_params(model, jax.random.PRNGKey(0))
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16)),
+            jnp.int32,
+        )
+        g_plain = jax.grad(
+            lambda p: llama_lib.loss_fn(model, p, tokens)
+        )(params)
+        mesh = create_mesh(dp=2, ep=2, pp=2)
+        pp_params = pp_lib.shard_pp_params(
+            pp_lib.pp_params_from_init(params, cfg, 2), mesh
+        )
+        loss_fn = pp_lib.make_pp_loss_fn(cfg, mesh, microbatch_size=2)
+        with mesh:
+            g_pp = jax.jit(jax.grad(loss_fn))(
+                pp_params, shard_batch(tokens, mesh)
+            )
+        stacked_plain = pp_lib.stack_block_params(g_plain, cfg.n_layers, 2)
+        for a, b in zip(jax.tree_util.tree_leaves(stacked_plain),
+                        jax.tree_util.tree_leaves(g_pp["blocks"])):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-4
+            )
+
+    def test_moe_sequential_fallback_normalizes_aux(self):
+        """On a mesh with NO pp axis, pipeline() runs the stages
+        sequentially over GLOBAL microbatches — the aux chunk count is
+        just M, not M·dp. A wrong divisor would silently weaken the
+        load-balance loss."""
+        cfg = llama_lib.tiny_moe(n_layers=4)
+        model = llama_lib.Llama(cfg)
+        params = llama_lib.init_params(model, jax.random.PRNGKey(0))
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16)),
+            jnp.int32,
+        )
+        l_plain = float(llama_lib.loss_fn(model, params, tokens))
+        mesh = create_mesh(dp=-1)  # no pp axis: sequential fallback
+        pp_params = pp_lib.shard_pp_params(
+            pp_lib.pp_params_from_init(params, cfg, 2), mesh
+        )
+        loss_fn = pp_lib.make_pp_loss_fn(cfg, mesh, microbatch_size=2)
+        with mesh:
+            l = float(jax.jit(loss_fn)(pp_params, shard_batch(tokens, mesh)))
+        np.testing.assert_allclose(l, l_plain, rtol=1e-5)
+
+    def test_moe_pp_rejects_fsdp_and_sp(self):
+        cfg = llama_lib.tiny_moe(n_layers=4)
+        with pytest.raises(ValueError, match="not fsdp"):
+            pp_lib.make_pp_loss_fn(
+                cfg, create_mesh(fsdp=2, ep=2, pp=2), microbatch_size=2
+            )
+        cfg_sp = llama_lib.tiny_moe(n_layers=4, attention_impl="ring")
+        with pytest.raises(ValueError, match="per sequence"):
+            pp_lib.make_pp_loss_fn(
+                cfg_sp, create_mesh(sp=2, ep=2, pp=2), microbatch_size=2
+            )
+
     def test_sp_mesh_requires_sp_attention(self):
         """A local-attention impl on an sp mesh would silently attend
         shard-locally — rejected loudly."""
@@ -313,13 +407,7 @@ class TestPipelinedLlama:
         with pytest.raises(ValueError, match="not divisible"):
             pp_lib.restack_block_params(pp4["blocks"], 3)
 
-    def test_rejects_moe_and_indivisible_layers(self, setup):
-        cfg, *_ = setup
-        mesh = create_mesh(dp=2, pp=4)
-        with pytest.raises(ValueError, match="dense"):
-            pp_lib.make_pp_loss_fn(
-                llama_lib.tiny_moe(), mesh, 2
-            )
+    def test_rejects_indivisible_layers(self):
         with pytest.raises(ValueError, match="not divisible"):
             pp_lib.stack_block_params({}, 5, 4)
 
@@ -345,14 +433,19 @@ class TestTrainerPP:
             ])
 
     def test_pp_rejects_other_parallel_axes(self):
-        # dp/fsdp/tp/sp compose with pp; ep does not (MoE routes tokens
-        # through an all-to-all that would fight the stage ppermute).
+        # Every axis composes with pp now — but each only where it
+        # means something: ep needs an MoE model on the mesh.
         from mpi_operator_tpu.cmd import train as train_cmd
 
-        with pytest.raises(SystemExit, match="compose with dp, fsdp, tp"):
+        with pytest.raises(SystemExit, match="needs an MoE model"):
             train_cmd.main([
                 "--model", "llama-tiny", "--steps", "1",
                 "--mesh", "ep=4,pp=2", "--seq-len", "16",
+            ])
+        with pytest.raises(SystemExit, match="fsdp"):
+            train_cmd.main([
+                "--model", "llama-moe-tiny", "--steps", "1",
+                "--mesh", "fsdp=2,ep=2,pp=2", "--seq-len", "16",
             ])
         # tp must divide the head counts (tiny has 4 q / 2 kv heads).
         with pytest.raises(SystemExit, match="divide by tp"):
