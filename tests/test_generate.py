@@ -200,6 +200,35 @@ class TestGenerate:
         )
         assert out["tokens"] == [int(t) for t in want[0]]
 
+    def test_cli_decodes_moe_from_pipelined_checkpoint(self, capsys,
+                                                       tmp_path):
+        """MoE + pp: the stage-stacked expert weights unstack into the
+        layer_i form the dense-all-experts decode path walks."""
+        import json as _json
+
+        from mpi_operator_tpu.cmd import generate as gen_cmd
+        from mpi_operator_tpu.models.llama_pp import pp_params_from_init
+        from mpi_operator_tpu.utils.checkpoint import CheckpointManager
+
+        cfg = llama_lib.tiny_moe()
+        model = llama_lib.Llama(cfg)
+        params = llama_lib.init_params(model, jax.random.PRNGKey(2))
+        pp_params = pp_params_from_init(params, cfg, n_stages=cfg.n_layers)
+        ckpt = CheckpointManager(str(tmp_path / "moepp"))
+        ckpt.save(1, {"params": pp_params}, force=True)
+        ckpt.close()
+
+        rc = gen_cmd.main([
+            "--checkpoint-dir", str(tmp_path / "moepp"),
+            "--model", "llama-moe-tiny", "--prompt", "7,3", "--max-new", "3",
+        ])
+        assert rc == 0
+        out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        want = generate(
+            params, jnp.asarray([[7, 3]], jnp.int32), cfg, max_new=3
+        )
+        assert out["tokens"] == [int(t) for t in want[0]]
+
     def test_cli_rejects_overlong_decode_and_wrong_pp_model(self, tmp_path):
         """prompt+max_new past the context window and a pipelined
         checkpoint whose depth mismatches --model both fail clearly."""
