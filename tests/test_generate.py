@@ -171,17 +171,23 @@ class TestGenerate:
                 "--prompt", "1,2", "--max-new", "0",
             ])
 
-    def test_cli_decodes_from_pipelined_checkpoint(self, capsys, tmp_path):
+    @pytest.mark.parametrize("cfg_fn,model_name", [
+        (llama_lib.tiny, "llama-tiny"),
+        (llama_lib.tiny_moe, "llama-moe-tiny"),
+    ])
+    def test_cli_decodes_from_pipelined_checkpoint(self, capsys, tmp_path,
+                                                   cfg_fn, model_name):
         """A pp-mesh training run stores stage-stacked {'blocks': ...}
-        params; the CLI must unstack them and decode identically to the
-        layer_i layout rather than dying on KeyError 'layer_0'."""
+        params; the CLI must unstack them (5-D expert leaves included)
+        and decode identically to the layer_i layout rather than dying
+        on KeyError 'layer_0'."""
         import json as _json
 
         from mpi_operator_tpu.cmd import generate as gen_cmd
         from mpi_operator_tpu.models.llama_pp import pp_params_from_init
         from mpi_operator_tpu.utils.checkpoint import CheckpointManager
 
-        cfg = llama_lib.tiny()
+        cfg = cfg_fn()
         model = llama_lib.Llama(cfg)
         params = llama_lib.init_params(model, jax.random.PRNGKey(0))
         pp_params = pp_params_from_init(params, cfg, n_stages=cfg.n_layers)
@@ -191,41 +197,12 @@ class TestGenerate:
 
         rc = gen_cmd.main([
             "--checkpoint-dir", str(tmp_path / "ppckpt"),
-            "--model", "llama-tiny", "--prompt", "5,11", "--max-new", "4",
+            "--model", model_name, "--prompt", "5,11", "--max-new", "4",
         ])
         assert rc == 0
         out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         want = generate(
             params, jnp.asarray([[5, 11]], jnp.int32), cfg, max_new=4
-        )
-        assert out["tokens"] == [int(t) for t in want[0]]
-
-    def test_cli_decodes_moe_from_pipelined_checkpoint(self, capsys,
-                                                       tmp_path):
-        """MoE + pp: the stage-stacked expert weights unstack into the
-        layer_i form the dense-all-experts decode path walks."""
-        import json as _json
-
-        from mpi_operator_tpu.cmd import generate as gen_cmd
-        from mpi_operator_tpu.models.llama_pp import pp_params_from_init
-        from mpi_operator_tpu.utils.checkpoint import CheckpointManager
-
-        cfg = llama_lib.tiny_moe()
-        model = llama_lib.Llama(cfg)
-        params = llama_lib.init_params(model, jax.random.PRNGKey(2))
-        pp_params = pp_params_from_init(params, cfg, n_stages=cfg.n_layers)
-        ckpt = CheckpointManager(str(tmp_path / "moepp"))
-        ckpt.save(1, {"params": pp_params}, force=True)
-        ckpt.close()
-
-        rc = gen_cmd.main([
-            "--checkpoint-dir", str(tmp_path / "moepp"),
-            "--model", "llama-moe-tiny", "--prompt", "7,3", "--max-new", "3",
-        ])
-        assert rc == 0
-        out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-        want = generate(
-            params, jnp.asarray([[7, 3]], jnp.int32), cfg, max_new=3
         )
         assert out["tokens"] == [int(t) for t in want[0]]
 
