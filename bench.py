@@ -363,6 +363,9 @@ def bench_llama(args) -> dict:
         remat_policy="dots",
         # Chunked head+CE: the [B, S, 32768] f32 logits never materialize.
         xent_chunk=512,
+        # On-hardware tuning surface for the >=50% MFU push.
+        flash_block_q=args.flash_block_q,
+        flash_block_k=args.flash_block_k,
     )
     model = llama_lib.Llama(cfg)
     params = llama_lib.init_params(
@@ -549,6 +552,10 @@ def main() -> int:
                         help="sequence length (default: 512 bert, 2048 llama)")
     parser.add_argument("--bert-batch", type=int, default=64)
     parser.add_argument("--llama-batch", type=int, default=8)
+    parser.add_argument("--flash-block-q", type=int, default=128,
+                        help="flash attention q-tile (llama suite)")
+    parser.add_argument("--flash-block-k", type=int, default=128,
+                        help="flash attention k-tile (llama suite)")
     parser.add_argument("--no-s2d", action="store_true",
                         help="disable the space-to-depth ResNet stem "
                              "(the MLPerf TPU transform; on by default)")

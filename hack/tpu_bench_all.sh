@@ -1,0 +1,65 @@
+#!/bin/bash
+# One-command TPU bench capture for the moment the axon tunnel answers.
+#
+# Probes the chip with a tiny naturally-exiting matmul first (never run
+# TPU work under a killable timeout — a killed client wedges the remote
+# runtime for hours), then runs every suite with a profile dir and
+# appends the JSON lines to BENCH_CAPTURE.jsonl plus markdown rows to
+# PERF_CAPTURE.md for PERF.md. Also A/Bs the fused pallas BN kernels
+# against XLA on the ResNet suite.
+#
+#   ./hack/tpu_bench_all.sh            # full capture
+#   ./hack/tpu_bench_all.sh probe      # probe only
+set -u
+cd "$(dirname "$0")/.."
+
+probe() {
+  python - <<'EOF'
+import sys, time
+import numpy as np
+t0 = time.time()
+import jax, jax.numpy as jnp
+try:
+    jax.devices()
+except Exception as e:
+    print(f"PROBE_FAIL init: {e!r}")
+    sys.exit(2)
+x = jnp.ones((512, 512), jnp.bfloat16)
+val = float(np.asarray(x @ x)[0, 0])
+print(f"PROBE_OK readback={val} init+run={time.time()-t0:.1f}s")
+EOF
+}
+
+echo "== probing the TPU =="
+if ! probe; then
+  echo "tunnel not answering; try again later"; exit 2
+fi
+[ "${1:-}" = "probe" ] && exit 0
+
+stamp=$(date -u +%Y%m%dT%H%M%S)
+out=BENCH_CAPTURE.jsonl
+md=PERF_CAPTURE.md
+echo "## TPU capture $stamp" >> "$md"
+
+run() {
+  label="$1"; shift
+  echo "== $label =="
+  log=$(mktemp)
+  # NO timeout wrapper — see the header.
+  python bench.py "$@" 2>&1 | tee "$log"
+  line=$(grep -E '^\{' "$log" | tail -1)
+  if [ -n "$line" ]; then
+    echo "{\"label\": \"$label\", \"stamp\": \"$stamp\", \"result\": $line}" >> "$out"
+    echo "- \`$label\`: \`$line\`" >> "$md"
+  else
+    echo "- \`$label\`: FAILED (see driver log)" >> "$md"
+  fi
+}
+
+run resnet101-s2d      --suite resnet --profile-dir /tmp/trace-resnet
+run resnet101-bn-pallas --suite resnet --bn-kernel pallas
+run bert-base          --suite bert --profile-dir /tmp/trace-bert
+run llama-0p7b         --suite llama --profile-dir /tmp/trace-llama
+run startup            --suite startup
+
+echo "== done; commit $out and fold $md into PERF.md =="

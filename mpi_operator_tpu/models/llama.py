@@ -57,6 +57,11 @@ class LlamaConfig:
     # head-sharded full-sequence flash between them; sp must divide the
     # head count). 'ring'/'ulysses' require a mesh.
     attention_impl: str = "flash"
+    # Flash kernel tile sizes — the on-hardware MFU tuning surface
+    # (bench.py --flash-block-q/-k). 128 matches the MXU/lane shape;
+    # longer sequences sometimes prefer 256/512 on the k side.
+    flash_block_q: int = 128
+    flash_block_k: int = 128
     # With ring attention: lay the sequence out zigzag (device i holds
     # chunks i and 2n-1-i) so causal work balances across the ring. The
     # model permutes after the embedding and unpermutes before the head;
@@ -215,6 +220,7 @@ class Attention(nn.Module):
         out = sp_attention(
             q, k, v, self.mesh, cfg.attention_impl, causal=True,
             zigzag=_use_zigzag(cfg, self.mesh),
+            block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
         )
         out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
         return dense(cfg.dim, "wo")(out)

@@ -35,6 +35,7 @@ without custom ring-level VJP code.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -126,13 +127,17 @@ def _dense_partial(q, k, v, row, col, causal, sm_scale):
     )
 
 
-def _flash_partial(q, k, v, row, col, causal, sm_scale):
+def _flash_partial(q, k, v, row, col, causal, sm_scale,
+                   block_q=128, block_k=128):
     if causal:
         out, lse = flash_attention_lse(
-            q, k, v, row_ids=row, col_ids=col, sm_scale=sm_scale
+            q, k, v, row_ids=row, col_ids=col, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k,
         )
     else:
-        out, lse = flash_attention_lse(q, k, v, sm_scale=sm_scale)
+        out, lse = flash_attention_lse(
+            q, k, v, sm_scale=sm_scale, block_q=block_q, block_k=block_k
+        )
     return out.astype(jnp.float32), lse
 
 
@@ -149,6 +154,8 @@ def ring_attention(
     sm_scale: Optional[float] = None,
     zigzag: bool = False,
     impl: str = "flash",
+    block_q: int = 128,
+    block_k: int = 128,
 ):
     """Per-shard ring attention — call inside shard_map/pmap.
 
@@ -171,7 +178,10 @@ def ring_attention(
     if zigzag and s_loc % 2:
         raise ValueError(f"zigzag needs an even local seq, got {s_loc}")
 
-    partial_fn = _flash_partial if impl == "flash" else _dense_partial
+    partial_fn = (
+        functools.partial(_flash_partial, block_q=block_q, block_k=block_k)
+        if impl == "flash" else _dense_partial
+    )
     row = _shard_ids(my, n, s_loc, zigzag)
 
     def step(carry, t):
@@ -225,6 +235,8 @@ def sp_attention(
     *,
     causal: bool,
     zigzag: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
 ):
     """The single attention dispatch for model code (llama, bert):
     'flash' (pallas kernel), 'dense' (XLA reference; GQA kv heads are
@@ -236,7 +248,9 @@ def sp_attention(
     from .attention import attention_reference, flash_attention
 
     if impl == "flash":
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k
+        )
     if impl == "dense":
         groups = q.shape[1] // k.shape[1]
         if groups > 1:
@@ -250,21 +264,29 @@ def sp_attention(
             )
         if impl == "ring":
             return ring_attention_shard_mapped(
-                q, k, v, mesh, causal=causal, zigzag=zigzag
+                q, k, v, mesh, causal=causal, zigzag=zigzag,
+                block_q=block_q, block_k=block_k,
             )
         from .ulysses import ulysses_attention_shard_mapped
 
-        return ulysses_attention_shard_mapped(q, k, v, mesh, causal=causal)
+        return ulysses_attention_shard_mapped(
+            q, k, v, mesh, causal=causal, block_q=block_q, block_k=block_k
+        )
     if impl in ("ring-shard", "ulysses-shard"):
         # The caller is ALREADY inside a manual region over sp (the
         # pp×sp pipeline stages, llama_pp) — run the per-shard kernels
         # directly; wrapping another shard_map here would be an illegal
         # nesting. No mesh needed: the sp axis is bound by the caller.
         if impl == "ring-shard":
-            return ring_attention(q, k, v, SP, causal=causal, zigzag=zigzag)
+            return ring_attention(
+                q, k, v, SP, causal=causal, zigzag=zigzag,
+                block_q=block_q, block_k=block_k,
+            )
         from .ulysses import ulysses_attention
 
-        return ulysses_attention(q, k, v, SP, causal=causal)
+        return ulysses_attention(
+            q, k, v, SP, causal=causal, block_q=block_q, block_k=block_k
+        )
     raise ValueError(
         f"unknown attention impl {impl!r}; want flash|dense|ring|ulysses"
     )
@@ -294,6 +316,8 @@ def ring_attention_shard_mapped(
     axis: str = SP,
     zigzag: bool = False,
     impl: str = "flash",
+    block_q: int = 128,
+    block_k: int = 128,
 ):
     """shard_map the per-shard ring kernel over the mesh — composable
     inside a larger jitted computation (models call this directly).
@@ -307,7 +331,7 @@ def ring_attention_shard_mapped(
     fn = shard_map(
         lambda a, b, c: ring_attention(
             a, b, c, axis, causal=causal, sm_scale=sm_scale,
-            zigzag=zigzag, impl=impl,
+            zigzag=zigzag, impl=impl, block_q=block_q, block_k=block_k,
         ),
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec),

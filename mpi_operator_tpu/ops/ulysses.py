@@ -57,6 +57,8 @@ def ulysses_attention(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     impl: str = "flash",
+    block_q: int = 128,
+    block_k: int = 128,
 ):
     """Per-shard Ulysses attention — call inside shard_map/pmap.
 
@@ -98,7 +100,10 @@ def ulysses_attention(
         q, k, v = a2a(q), a2a(k), a2a(v)
 
     if impl == "flash":
-        out = flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        out = flash_attention(
+            q, k, v, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k,
+        )
     else:
         groups = q.shape[1] // k.shape[1]
         if groups > 1:
@@ -122,6 +127,8 @@ def ulysses_attention_shard_mapped(
     sm_scale: Optional[float] = None,
     axis: str = SP,
     impl: str = "flash",
+    block_q: int = 128,
+    block_k: int = 128,
 ):
     """shard_map the per-shard Ulysses kernel over the mesh — composable
     inside a larger jitted computation (models call this directly).
@@ -137,7 +144,8 @@ def ulysses_attention_shard_mapped(
     q_spec, kv_spec = sp_attention_specs(mesh, q.shape[1], k.shape[1], axis)
     fn = shard_map(
         lambda a, b, c: ulysses_attention(
-            a, b, c, axis, causal=causal, sm_scale=sm_scale, impl=impl
+            a, b, c, axis, causal=causal, sm_scale=sm_scale, impl=impl,
+            block_q=block_q, block_k=block_k,
         ),
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec),

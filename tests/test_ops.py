@@ -129,6 +129,30 @@ class TestFlashAttention:
             flash_attention(q, k, v)
 
 
+class TestBlockSizeInvariance:
+    def test_nondefault_tiles_change_nothing(self):
+        """block_q/block_k are a pure scheduling knob (the bench's MFU
+        tuning surface) — outputs must be identical across tile sizes,
+        through the model-level config plumbing too."""
+        import numpy as np
+
+        from mpi_operator_tpu.models import llama as llama_lib
+
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(1, 250, (2, 64)), jnp.int32
+        )
+        losses = []
+        for bq, bk in [(128, 128), (64, 64), (64, 128)]:
+            cfg = llama_lib.tiny(
+                attention_impl="flash", flash_block_q=bq, flash_block_k=bk
+            )
+            model = llama_lib.Llama(cfg)
+            params = llama_lib.init_params(model, jax.random.PRNGKey(0))
+            losses.append(float(llama_lib.loss_fn(model, params, tokens)))
+        np.testing.assert_allclose(losses[1], losses[0], rtol=1e-6)
+        np.testing.assert_allclose(losses[2], losses[0], rtol=1e-6)
+
+
 class TestFlashAttentionLse:
     """The (out, lse) variant ring attention builds its hop merge on."""
 
