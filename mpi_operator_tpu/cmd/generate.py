@@ -19,6 +19,7 @@ uint32 streams).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import sys
 
@@ -38,6 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy; > 0 = softmax sampling")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--mesh", default="",
+                   help="axis=size pairs (e.g. tp=4 or tp=4,fsdp=2) to "
+                        "shard the weights for decoding — big checkpoints "
+                        "decode without fitting one chip; GSPMD inserts "
+                        "the collectives (empty = single device)")
     return p
 
 
@@ -106,10 +112,55 @@ def main(argv=None) -> int:
 
     prompt = jnp.asarray([prompt_ids], jnp.int32)
     rng = jax.random.PRNGKey(args.seed) if args.temperature > 0 else None
-    out = generate(
-        params, prompt, cfg,
-        max_new=args.max_new, temperature=args.temperature, rng=rng,
-    )
+    ctx = contextlib.nullcontext()
+    if args.mesh:
+        from .train import parse_mesh_spec
+        from ..parallel import create_mesh, shard_params
+
+        sizes = parse_mesh_spec(args.mesh)
+        bad = [a for a, n in sizes.items()
+               if a not in ("dp", "fsdp", "tp", "ep") and n > 1]
+        if bad:
+            raise SystemExit(
+                f"decode meshes take dp/fsdp/tp (+ep for MoE); {bad} "
+                f"have no decode-time meaning (pp layouts are unstacked "
+                f"above; there is no sequence to shard)"
+            )
+        tp = sizes.get("tp", 1)
+        # Decode shards FLAT feature dims (GSPMD einsums), so the
+        # constraint is on the dims the rules actually split — not the
+        # train-time head counts (indivisible heads just replicate).
+        sharded_dims = {
+            "dim": cfg.dim, "ffn_dim": cfg.ffn_dim,
+            "attn features": cfg.n_heads * cfg.head_dim,
+            "vocab": cfg.vocab_size,
+        }
+        bad_dims = [k for k, v in sharded_dims.items() if v % tp]
+        if tp > 1 and bad_dims:
+            raise SystemExit(
+                f"tp={tp} must divide the sharded dims; it does not "
+                f"divide {bad_dims} "
+                f"({ {k: sharded_dims[k] for k in bad_dims} })"
+            )
+        ep = sizes.get("ep", 1)
+        if ep > 1 and not cfg.is_moe:
+            raise SystemExit(
+                f"--mesh ep={ep} needs an MoE model; {args.model} is dense"
+            )
+        if ep > 1 and cfg.n_experts % ep:
+            raise SystemExit(
+                f"{cfg.n_experts} experts not divisible by ep={ep}"
+            )
+        mesh = create_mesh(**sizes)
+        params = shard_params(
+            params, mesh, rules=llama_lib.param_sharding_rules(mesh)
+        )
+        ctx = mesh
+    with ctx:
+        out = generate(
+            params, prompt, cfg,
+            max_new=args.max_new, temperature=args.temperature, rng=rng,
+        )
     tokens = [int(t) for t in out[0]]
     print(json.dumps({
         "step": step,
