@@ -266,7 +266,9 @@ def bench_bert(args) -> dict:
     n = len(jax.devices())
     mesh = create_mesh(dp=-1)  # data-parallel over every chip
     seq_len = args.seq_len or 512
-    cfg = bert_lib.bert_base()
+    cfg = bert_lib.bert_base(
+        flash_block_q=args.flash_block_q, flash_block_k=args.flash_block_k
+    )
     model = bert_lib.Bert(cfg)
     params = bert_lib.init_params(
         model, jax.random.PRNGKey(0), batch=2, seq=seq_len
@@ -553,9 +555,9 @@ def main() -> int:
     parser.add_argument("--bert-batch", type=int, default=64)
     parser.add_argument("--llama-batch", type=int, default=8)
     parser.add_argument("--flash-block-q", type=int, default=128,
-                        help="flash attention q-tile (llama suite)")
+                        help="flash attention q-tile (bert/llama suites)")
     parser.add_argument("--flash-block-k", type=int, default=128,
-                        help="flash attention k-tile (llama suite)")
+                        help="flash attention k-tile (bert/llama suites)")
     parser.add_argument("--no-s2d", action="store_true",
                         help="disable the space-to-depth ResNet stem "
                              "(the MLPerf TPU transform; on by default)")

@@ -36,6 +36,10 @@ class BertConfig:
     # (non-causal ppermute ring) / 'ulysses' (two all-to-alls). The
     # sp strategies need a mesh on the module.
     attention_impl: str = "flash"
+    # Flash kernel tile sizes (bench.py --flash-block-q/-k analog for
+    # the BERT suite) — pure scheduling knobs, outputs are invariant.
+    flash_block_q: int = 128
+    flash_block_k: int = 128
 
 
 def bert_base(**overrides) -> BertConfig:
@@ -69,7 +73,8 @@ class EncoderLayer(nn.Module):
         from ..ops.ring_attention import sp_attention
 
         att = sp_attention(
-            q, k, v, self.mesh, cfg.attention_impl, causal=False
+            q, k, v, self.mesh, cfg.attention_impl, causal=False,
+            block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
         )
         att = att.transpose(0, 2, 1, 3).reshape(b, s, cfg.dim)
         x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="attn_norm")(
