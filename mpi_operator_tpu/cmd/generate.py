@@ -10,7 +10,9 @@ images: train on the operator, decode from the artifact.
         --checkpoint-dir /ckpt/llama --model llama-tiny \
         --prompt 12,7,42 --max-new 16 [--temperature 0.8 --seed 1]
 
-Prints one JSON line: {"prompt": [...], "tokens": [...], "new": [...]}.
+Prints one JSON line PER PROMPT, batch order preserved (repeat
+--prompt to decode several equal-length prompts in one compiled call):
+{"prompt": [...], "tokens": [...], "new": [...]}.
 Token IDs in/out — tokenizers are corpus-specific and out of scope, the
 same boundary the data loader draws (data/loader.py reads pre-tokenized
 uint32 streams).
@@ -33,8 +35,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model", default="llama-tiny",
                    help="llama3-8b|llama-tiny|mixtral-8x7b|llama-moe-tiny "
                         "(must match the training run)")
-    p.add_argument("--prompt", required=True,
-                   help="comma-separated token ids, e.g. 12,7,42")
+    p.add_argument("--prompt", required=True, action="append",
+                   help="comma-separated token ids, e.g. 12,7,42; repeat "
+                        "the flag to decode a batch in one compiled call "
+                        "(prompts must share a length — the static KV "
+                        "cache admits one shape per compile)")
     p.add_argument("--max-new", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy; > 0 = softmax sampling")
@@ -50,11 +55,21 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     try:
-        prompt_ids = [int(t) for t in args.prompt.split(",") if t.strip()]
+        prompts = [
+            [int(t) for t in spec.split(",") if t.strip()]
+            for spec in args.prompt
+        ]
     except ValueError:
         raise SystemExit("--prompt must be comma-separated integer token ids")
-    if not prompt_ids:
-        raise SystemExit("--prompt must contain at least one token id")
+    if any(not p for p in prompts):
+        raise SystemExit("every --prompt must contain at least one token id")
+    if len({len(p) for p in prompts}) > 1:
+        raise SystemExit(
+            f"batched prompts must share a length (got "
+            f"{sorted({len(p) for p in prompts})}); the static KV cache "
+            f"admits one shape per compile — pad or bucket upstream"
+        )
+    prompt_ids = prompts[0]  # length/vocab checks apply batch-wide
     if args.max_new < 1:
         raise SystemExit("--max-new must be >= 1")
 
@@ -69,7 +84,7 @@ def main(argv=None) -> int:
         cfg = llama_lib.config_for(args.model)
     except KeyError:
         raise SystemExit(f"unknown --model {args.model!r} (llama family only)")
-    bad = [t for t in prompt_ids if not 0 <= t < cfg.vocab_size]
+    bad = [t for p in prompts for t in p if not 0 <= t < cfg.vocab_size]
     if bad:
         raise SystemExit(
             f"prompt ids {bad} outside the model vocab [0, {cfg.vocab_size})"
@@ -110,7 +125,7 @@ def main(argv=None) -> int:
         params = {k: v for k, v in params.items() if k != "blocks"}
         params.update(blocks)
 
-    prompt = jnp.asarray([prompt_ids], jnp.int32)
+    prompt = jnp.asarray(prompts, jnp.int32)  # [B, S0]
     rng = jax.random.PRNGKey(args.seed) if args.temperature > 0 else None
     ctx = contextlib.nullcontext()
     if args.mesh:
@@ -161,13 +176,17 @@ def main(argv=None) -> int:
             params, prompt, cfg,
             max_new=args.max_new, temperature=args.temperature, rng=rng,
         )
-    tokens = [int(t) for t in out[0]]
-    print(json.dumps({
-        "step": step,
-        "prompt": prompt_ids,
-        "tokens": tokens,
-        "new": tokens[len(prompt_ids):],
-    }))
+    # One JSON line per prompt, batch order preserved (a single prompt
+    # prints exactly what it always did).
+    s0 = len(prompt_ids)
+    for row, p in zip(out, prompts):
+        tokens = [int(t) for t in row]
+        print(json.dumps({
+            "step": step,
+            "prompt": p,
+            "tokens": tokens,
+            "new": tokens[s0:],
+        }))
     return 0
 
 

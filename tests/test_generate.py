@@ -239,6 +239,43 @@ class TestGenerate:
                 "--model", "llama-tiny", "--prompt", "1", "--max-new", "2",
             ])
 
+    def test_cli_batched_prompts_one_line_each(self, capsys, tmp_path):
+        """Repeated --prompt flags decode as one [B, S0] batch: each row
+        must equal its own single-prompt run (batching must not leak
+        between rows), printed one JSON line per prompt in order."""
+        import json as _json
+
+        from mpi_operator_tpu.cmd import generate as gen_cmd
+        from mpi_operator_tpu.utils.checkpoint import CheckpointManager
+
+        cfg = llama_lib.tiny()
+        model = llama_lib.Llama(cfg)
+        params = llama_lib.init_params(model, jax.random.PRNGKey(0))
+        ckpt = CheckpointManager(str(tmp_path / "c"))
+        ckpt.save(1, {"params": params}, force=True)
+        ckpt.close()
+        base = ["--checkpoint-dir", str(tmp_path / "c"),
+                "--model", "llama-tiny", "--max-new", "4"]
+
+        singles = []
+        for p in ("3,9", "7,1"):
+            assert gen_cmd.main(base + ["--prompt", p]) == 0
+            singles.append(_json.loads(
+                capsys.readouterr().out.strip().splitlines()[-1]
+            ))
+        assert gen_cmd.main(
+            base + ["--prompt", "3,9", "--prompt", "7,1"]
+        ) == 0
+        lines = [
+            _json.loads(ln) for ln in
+            capsys.readouterr().out.strip().splitlines()[-2:]
+        ]
+        for got, want in zip(lines, singles):
+            assert got["tokens"] == want["tokens"]
+            assert got["prompt"] == want["prompt"]
+        with pytest.raises(SystemExit, match="share a length"):
+            gen_cmd.main(base + ["--prompt", "3,9", "--prompt", "7"])
+
     def test_cli_sharded_decode_matches_single_device(self, capsys,
                                                       tmp_path):
         """--mesh tp=2,fsdp=2,dp=2: weights shard for decoding (GSPMD
