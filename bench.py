@@ -151,6 +151,29 @@ def _param_count(params) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(params))
 
 
+def _timed_steps_maybe_profiled(fn, state, args_rest, args):
+    """`_timed_steps` with the optional ``--profile-dir`` capture every
+    suite shares: warm/compile fully BEFORE the trace so it holds only
+    steady-state steps, then log the trace-derived device ms/step next
+    to the wall-clock diff-quotient (a tunnel-timing regression is
+    visible immediately)."""
+    import jax
+
+    warmup = max(args.warmup, 1)  # >=1: compile outside the timed window
+    if not args.profile_dir:
+        return _timed_steps(fn, state, args_rest, args.steps, warmup)
+    state, _ = _timed_steps(fn, state, args_rest, 0, warmup)
+    jax.profiler.start_trace(args.profile_dir)
+    state, sec = _timed_steps(fn, state, args_rest, args.steps, 0)
+    jax.profiler.stop_trace()
+    log(f"profile written to {args.profile_dir}")
+    dev_ms = _device_ms_per_step(args.profile_dir)
+    if dev_ms:
+        log(f"device time from trace: {dev_ms:.1f} ms/step "
+            f"(wall-clock diff-quotient: {sec * 1e3:.1f})")
+    return state, sec
+
+
 # ---------------------------------------------------------------------------
 # ResNet (headline, milestone 2)
 # ---------------------------------------------------------------------------
@@ -211,25 +234,10 @@ def bench_resnet(args) -> dict:
     log(f"compiling resnet{args.depth} train step (global batch {global_batch})...")
     fn = lambda p, b, o, i, l: step(p, b, o, i, l)[:3]  # drop loss from carry
     state = (params, batch_stats, opt_state)
-    warmup = max(args.warmup, 1)  # >=1: compile outside the timed window
     with mesh:
-        if args.profile_dir:
-            # Warm/compile fully BEFORE the trace so it holds only
-            # steady-state steps (the two timed windows: steps//4 + steps
-            # executions; _device_ms_per_step divides by the traced count).
-            state, _ = _timed_steps(fn, state, (images, labels), 0, warmup)
-            jax.profiler.start_trace(args.profile_dir)
-            state, sec = _timed_steps(fn, state, (images, labels), args.steps, 0)
-            jax.profiler.stop_trace()
-            log(f"profile written to {args.profile_dir}")
-            dev_ms = _device_ms_per_step(args.profile_dir)
-            if dev_ms:
-                log(f"device time from trace: {dev_ms:.1f} ms/step "
-                    f"(wall-clock diff-quotient: {sec * 1e3:.1f})")
-        else:
-            state, sec = _timed_steps(
-                fn, state, (images, labels), args.steps, warmup
-            )
+        state, sec = _timed_steps_maybe_profiled(
+            fn, state, (images, labels), args
+        )
 
     per_chip = global_batch / sec / n
     flops = 3 * resnet_lib.flops_per_image(args.depth, args.image_size)
@@ -304,10 +312,10 @@ def bench_bert(args) -> dict:
     log(f"compiling bert-base train step (batch {batch} x seq {seq_len}, "
         f"{n_pred} preds/seq, {n_params / 1e6:.0f}M params)...")
     with mesh:
-        (_, _, loss), sec = _timed_steps(
+        (_, _, loss), sec = _timed_steps_maybe_profiled(
             lambda p, o, l_, t, pos, tg, w: step(p, o, t, pos, tg, w),
             (params, opt_state, None), (tokens, positions, targets, weights),
-            args.steps, max(args.warmup, 1),
+            args,
         )
 
     seqs_per_sec = batch / sec / n
@@ -391,10 +399,10 @@ def bench_llama(args) -> dict:
     log(f"compiling llama train step ({n_params / 1e6:.0f}M params, "
         f"batch {batch} x seq {seq_len})...")
     with mesh:
-        (_, _, loss), sec = _timed_steps(
+        (_, _, loss), sec = _timed_steps_maybe_profiled(
             lambda p, o, l_, t: step(p, o, t),
             (params, opt_state, None), (tokens,),
-            args.steps, max(args.warmup, 1),
+            args,
         )
 
     tokens_per_sec = batch * seq_len / sec / n
