@@ -1,0 +1,111 @@
+"""In-process MFU tuning sweep for the transformer bench suites.
+
+One python process = ONE tunnel/backend initialization, then every
+config in the sweep runs sequentially through the same bench entry
+points (`bench.bench_llama` / `bench.bench_bert`). Restarting the
+process per config would pay the remote-backend init (~30 s) and lose
+nothing — the XLA compile cache is per-HLO anyway — so the sweep runs
+in-process, mirroring how `--suite all` reuses one backend.
+
+    python hack/tpu_tune.py llama            # the llama sweep
+    python hack/tpu_tune.py bert             # the bert sweep
+    python hack/tpu_tune.py llama --quick    # first 3 configs only
+
+Every result is appended to TUNE_CAPTURE.jsonl as it lands (a later
+config OOMing or the tunnel dying never loses earlier points).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import bench  # noqa: E402
+
+
+def ns(**kw) -> argparse.Namespace:
+    """bench args namespace derived from bench's OWN parser defaults
+    (a hand-mirrored copy would drift every time a flag is added),
+    with sweep overrides applied on top."""
+    base = bench.build_parser().parse_args([])
+    base.steps, base.warmup = 20, 2  # sweep points are shorter than captures
+    for k, v in kw.items():
+        if not hasattr(base, k):
+            raise AttributeError(f"unknown bench arg {k!r} in sweep config")
+        setattr(base, k, v)
+    return base
+
+
+LLAMA_SWEEP = [
+    # name, overrides — ordered so the most informative A/Bs come first.
+    ("base-b4-dots-fb128", {}),
+    ("fb256", {"flash_block_q": 256, "flash_block_k": 256}),
+    ("fb512q-256k", {"flash_block_q": 512, "flash_block_k": 256}),
+    ("full-remat-b8", {"remat_policy": "full", "llama_batch": 8}),
+    ("full-remat-b4", {"remat_policy": "full"}),
+    ("xent-chunk-1024", {"xent_chunk": 1024}),
+    ("xent-chunk-2048", {"xent_chunk": 2048}),
+    ("seq4096-b2", {"seq_len": 4096, "llama_batch": 2}),
+    ("b6-dots", {"llama_batch": 6}),
+]
+
+BERT_SWEEP = [
+    ("base-b64-fb128", {"suite": "bert"}),
+    ("fb256", {"suite": "bert", "flash_block_q": 256, "flash_block_k": 256}),
+    ("b128", {"suite": "bert", "bert_batch": 128}),
+    ("b256", {"suite": "bert", "bert_batch": 256}),
+    ("b128-fb256", {"suite": "bert", "bert_batch": 128,
+                    "flash_block_q": 256, "flash_block_k": 256}),
+]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("which", choices=["llama", "bert"])
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default="TUNE_CAPTURE.jsonl")
+    ap.add_argument("--profile-best", default="",
+                    help="after the sweep, rerun the best config with "
+                         "this profile dir")
+    args = ap.parse_args()
+
+    sweep = LLAMA_SWEEP if args.which == "llama" else BERT_SWEEP
+    fn = bench.bench_llama if args.which == "llama" else bench.bench_bert
+    if args.quick:
+        sweep = sweep[:3]
+
+    results = []
+    for name, overrides in sweep:
+        bench.log(f"=== tune[{args.which}] {name} ===")
+        try:
+            r = fn(ns(**overrides))
+        except Exception as e:  # noqa: BLE001 - a config OOMing must
+            # not lose the rest of the sweep
+            bench.log(f"tune {name} FAILED: {type(e).__name__}: "
+                      f"{str(e)[:300]}")
+            traceback.print_exc(limit=3)
+            r = {"error": f"{type(e).__name__}"}
+        row = {"config": name, "overrides": overrides, "result": r}
+        results.append(row)
+        with open(args.out, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+    ok = [r for r in results if "error" not in r["result"]]
+    ok.sort(key=lambda r: -r["result"]["vs_baseline"])
+    for r in ok:
+        bench.log(f"{r['result']['vs_baseline']:.3f}  {r['config']}  "
+                  f"{r['result']['value']} {r['result']['unit']}")
+    if ok and args.profile_best:
+        best = ok[0]
+        bench.log(f"=== profiling best config {best['config']} ===")
+        fn(ns(profile_dir=args.profile_best, **best["overrides"]))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

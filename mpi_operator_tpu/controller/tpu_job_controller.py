@@ -44,6 +44,7 @@ from ..api.v2beta1.types import (
     TPUJob,
 )
 from ..runtime.apiserver import (
+    AlreadyExistsError,
     ConflictError,
     InMemoryAPIServer,
     NotFoundError,
@@ -403,6 +404,12 @@ class TPUJobController:
                         builders.new_launcher_job(job, self.gang_scheduler_name)
                     )
                     launcher = launcher_obj.to_dict()
+                except AlreadyExistsError:
+                    # Stale cache (see _get_or_create_service docstring).
+                    launcher = self._read_through_adopt(
+                        self.kube.jobs(namespace), job,
+                        builders.launcher_name(job),
+                    )
                 except Exception as e:
                     self.recorder.eventf(
                         job,
@@ -426,6 +433,20 @@ class TPUJobController:
         )
         self.recorder.event(job, EVENT_TYPE_WARNING, ERR_RESOURCE_EXISTS_REASON, msg)
 
+    def _read_through_adopt(self, client, job: TPUJob, name: str) -> dict:
+        """After a create hit AlreadyExists because the informer cache
+        lags the apiserver: fetch the live object and enforce the same
+        adoption check every cached path applies. One place for the
+        read-through discipline all five create sites share."""
+        existing = client.get(name).to_dict()
+        if not is_controlled_by(existing, job):
+            self._flag_not_controlled(job, existing)
+            raise RuntimeError(
+                f"{existing.get('kind', 'object')} {name} exists and is not "
+                f"controlled by TPUJob {job.name}"
+            ) from None
+        return existing
+
     def _get_launcher_job(self, job: TPUJob) -> Optional[dict]:
         """getLauncherJob :592-613 analog."""
         existing = self.job_informer.lister.get(job.namespace, builders.launcher_name(job))
@@ -440,10 +461,21 @@ class TPUJobController:
         return existing
 
     def _get_or_create_service(self, job: TPUJob, desired: KubeObject) -> dict:
-        """getOrCreateService :736-757 analog (selector kept in sync)."""
+        """getOrCreateService :736-757 analog (selector kept in sync).
+
+        Create races read through to the apiserver instead of failing the
+        sync: the informer cache routinely lags a create this controller
+        itself just did, and aborting costs a whole backoff requeue (the
+        reference pays that requeue; measured directly in our startup
+        bench latency)."""
         existing = self.service_informer.lister.get(job.namespace, desired.name)
         if existing is None:
-            return self.kube.services(job.namespace).create(desired).to_dict()
+            try:
+                return self.kube.services(job.namespace).create(desired).to_dict()
+            except AlreadyExistsError:
+                existing = self._read_through_adopt(
+                    self.kube.services(job.namespace), job, desired.name
+                )
         if not is_controlled_by(existing, job):
             self._flag_not_controlled(job, existing)
             raise RuntimeError(f"Service {desired.name} not controlled by us")
@@ -462,25 +494,51 @@ class TPUJobController:
 
         existing = self.configmap_informer.lister.get(job.namespace, desired.name)
         if existing is None:
-            return self.kube.configmaps(job.namespace).create(desired).to_dict()
+            try:
+                return self.kube.configmaps(job.namespace).create(desired).to_dict()
+            except AlreadyExistsError:  # stale cache; see _get_or_create_service
+                existing = self._read_through_adopt(
+                    self.kube.configmaps(job.namespace), job, desired.name
+                )
         if not is_controlled_by(existing, job):
             self._flag_not_controlled(job, existing)
             raise RuntimeError(f"ConfigMap {desired.name} not controlled by us")
         if existing.get("data") != desired.data:
             updated = KubeObject.from_dict(existing)
             updated.data = desired.data
-            return self.kube.configmaps(job.namespace).update(updated).to_dict()
+            try:
+                return self.kube.configmaps(job.namespace).update(updated).to_dict()
+            except ConflictError:
+                # Cached resourceVersion lagged a write this controller
+                # already made (discover-hosts updates happen every sync):
+                # re-read, re-diff, one retry. A further race waits for
+                # the next sync. The re-read object may be a same-named
+                # foreign recreate — the adoption check must run again
+                # before writing over it.
+                fresh = self._read_through_adopt(
+                    self.kube.configmaps(job.namespace), job, desired.name
+                )
+                if fresh.get("data") == desired.data:
+                    return fresh
+                refreshed = KubeObject.from_dict(fresh)
+                refreshed.data = desired.data
+                return self.kube.configmaps(job.namespace).update(refreshed).to_dict()
         return existing
 
     def _get_or_create_pod_group(self, job: TPUJob, min_member: int) -> dict:
         """getOrCreatePodGroups :616-637 analog."""
         existing = self.podgroup_informer.lister.get(job.namespace, job.name)
         if existing is None:
-            return (
-                self.scheduling.podgroups(job.namespace)
-                .create(builders.new_pod_group(job, min_member))
-                .to_dict()
-            )
+            try:
+                return (
+                    self.scheduling.podgroups(job.namespace)
+                    .create(builders.new_pod_group(job, min_member))
+                    .to_dict()
+                )
+            except AlreadyExistsError:  # stale cache; see _get_or_create_service
+                existing = self._read_through_adopt(
+                    self.scheduling.podgroups(job.namespace), job, job.name
+                )
         if not is_controlled_by(existing, job):
             self._flag_not_controlled(job, existing)
             raise RuntimeError(f"PodGroup {job.name} not controlled by us")
@@ -594,6 +652,11 @@ class TPUJobController:
                         self.kube.pods(job.namespace)
                         .create(builders.new_worker(job, i, self.gang_scheduler_name))
                         .to_dict()
+                    )
+                except AlreadyExistsError:
+                    # Stale cache (see _get_or_create_service docstring).
+                    pod = self._read_through_adopt(
+                        self.kube.pods(job.namespace), job, name
                     )
                 except Exception as e:
                     self.recorder.eventf(

@@ -647,3 +647,131 @@ class TestStatusUpdateConflict:
         assert after.status.replica_statuses.get("Worker") is None or (
             after.status.replica_statuses["Worker"].active != 4
         )
+
+
+class TestStaleCacheCreateRace:
+    """A create that hits AlreadyExists because the informer cache lags
+    the apiserver must read through and continue the sync, not abort
+    into a backoff requeue (this race fired on every startup-bench run:
+    the controller's own just-created objects were not yet in cache)."""
+
+    def _pre_create(self, f, job, resource, obj):
+        # Into the apiserver but deliberately NOT pumped into informers.
+        f.api.create(resource, obj)
+
+    def test_service_created_elsewhere_is_adopted_mid_sync(self):
+        f = Fixture()
+        f.start()
+        job = f.create_job(f.new_job())
+        f.controller.factory.pump_until_quiet()  # cache sees the job only
+        svc = builders.new_workers_service(f.get_job()).to_dict()
+        self._pre_create(f, job, "services", svc)
+        # No pump: the service lister is stale. Sync must still succeed
+        # and go on to create all four workers.
+        f.controller.sync_handler("default/test-job")
+        pods = f.api.list("pods", "default")
+        assert len(pods) == 4
+        assert ("Warning", "ErrResourceExists") not in f.events()
+
+    def test_worker_pod_created_elsewhere_is_adopted_mid_sync(self):
+        f = Fixture()
+        f.start()
+        job = f.create_job(f.new_job())
+        f.controller.factory.pump_until_quiet()
+        pod0 = builders.new_worker(f.get_job(), 0, "")
+        self._pre_create(f, job, "pods", pod0.to_dict())
+        f.controller.sync_handler("default/test-job")
+        pods = f.api.list("pods", "default")
+        assert len(pods) == 4  # pod 0 adopted, 1-3 created
+
+    def test_foreign_worker_pod_still_rejected(self):
+        f = Fixture()
+        f.start()
+        job = f.create_job(f.new_job())
+        f.controller.factory.pump_until_quiet()
+        name = builders.worker_name(f.get_job(), 0)
+        self._pre_create(
+            f, job, "pods",
+            {"metadata": {"name": name, "namespace": "default"}},
+        )
+        with pytest.raises(RuntimeError, match="not controlled"):
+            f.controller.sync_handler("default/test-job")
+
+    def test_launcher_created_elsewhere_is_used_mid_sync(self):
+        f = Fixture()
+        f.start()
+        job = f.create_job(f.new_job(launcher=True))
+        f.controller.factory.pump_until_quiet()
+        lj = builders.new_launcher_job(f.get_job(), "").to_dict()
+        self._pre_create(f, job, "jobs", lj)
+        f.controller.sync_handler("default/test-job")
+        jobs = f.api.list("jobs", "default")
+        assert len(jobs) == 1  # no duplicate launcher
+
+    def test_foreign_launcher_mid_sync_still_rejected(self):
+        f = Fixture()
+        f.start()
+        job = f.create_job(f.new_job(launcher=True))
+        f.controller.factory.pump_until_quiet()
+        name = builders.launcher_name(f.get_job())
+        self._pre_create(
+            f, job, "jobs",
+            {"metadata": {"name": name, "namespace": "default"}},
+        )
+        with pytest.raises(RuntimeError, match="not controlled"):
+            f.controller.sync_handler("default/test-job")
+
+    def test_configmap_update_conflict_reads_through(self):
+        f = Fixture()
+        f.start()
+        f.create_job(f.new_job())
+        f.controller.factory.pump_until_quiet()
+        f.controller.sync_handler("default/test-job")
+        # Freeze a stale snapshot of the ConfigMap (pre-Running, old rv).
+        import copy
+
+        stale = copy.deepcopy(f.api.get("configmaps", "default", "test-job-config"))
+        # The cluster moves on: workers go Running (discover_hosts will
+        # differ) and an out-of-band write bumps the rv further.
+        for i in range(4):
+            f.set_pod_phase(builders.worker_name(f.get_job(), i), "Running")
+        cm = f.api.get("configmaps", "default", "test-job-config")
+        cm["metadata"]["labels"] = {"touched": "yes"}
+        f.api.update("configmaps", cm)
+        f.controller.factory.pump_until_quiet()
+        # Wind the informer cache back to the stale snapshot: the update
+        # diff now computes against an rv the apiserver will reject.
+        f.controller.configmap_informer._cache["default/test-job-config"] = stale
+        f.controller.sync_handler("default/test-job")  # must not raise
+        got = f.api.get("configmaps", "default", "test-job-config")
+        # The discover-hosts refresh landed despite the conflict...
+        for i in range(4):
+            assert builders.worker_name(f.get_job(), i) in got["data"]["discover_hosts.sh"]
+        # ...onto the CURRENT object (out-of-band label preserved).
+        assert got["metadata"]["labels"] == {"touched": "yes"}
+
+    def test_configmap_conflict_foreign_recreate_rejected(self):
+        f = Fixture()
+        f.start()
+        f.create_job(f.new_job())
+        f.controller.factory.pump_until_quiet()
+        f.controller.sync_handler("default/test-job")
+        import copy
+
+        stale = copy.deepcopy(f.api.get("configmaps", "default", "test-job-config"))
+        for i in range(4):
+            f.set_pod_phase(builders.worker_name(f.get_job(), i), "Running")
+        # Delete + foreign recreate under the same name: new uid, no
+        # ownerRef. The stale update conflicts; the retry must NOT stomp.
+        f.api.delete("configmaps", "default", "test-job-config")
+        f.api.create(
+            "configmaps",
+            {"metadata": {"name": "test-job-config", "namespace": "default"},
+             "data": {"foreign": "yes"}},
+        )
+        f.controller.factory.pump_until_quiet()
+        f.controller.configmap_informer._cache["default/test-job-config"] = stale
+        with pytest.raises(RuntimeError, match="not controlled"):
+            f.controller.sync_handler("default/test-job")
+        got = f.api.get("configmaps", "default", "test-job-config")
+        assert got["data"] == {"foreign": "yes"}  # untouched
