@@ -1,0 +1,126 @@
+"""Bisect the --bn-kernel pallas compile hang on real TPU hardware.
+
+The round-3 capture found that the ResNet-101 train step with
+`bn_impl="pallas"` (~100 pallas reduction calls in one XLA program)
+never came back from the remote AOT compiler (>29 min; the XLA-BN
+variant compiles in ~2 min). This probe escalates gradually so the
+hang can be localized without burning another half hour:
+
+    python hack/bn_probe.py 1     # ONE bn_stats kernel, jitted alone
+    python hack/bn_probe.py 2     # stats+grads pair (fused_batch_norm vjp)
+    python hack/bn_probe.py 3     # every distinct ResNet-101 BN shape, one
+                                  #   program per shape (compile times each)
+    python hack/bn_probe.py 4     # all shapes in ONE program (the hang repro)
+    python hack/bn_probe.py 5     # stage 1 + timing vs the XLA reduce
+
+Each stage prints PROBE_STAGE_OK <n> <seconds>; run them in order and
+the first stage that stalls is the answer. Never run under a killable
+timeout (a killed client can wedge the tunnel — see PERF.md).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+# Distinct (rows, channels) shapes of ResNet-101 BN layers at batch 128
+# with the s2d stem (rows = B*H*W of the stage's feature map).
+RESNET101_BN_SHAPES = [
+    (128 * 56 * 56, 64),
+    (128 * 56 * 56, 256),
+    (128 * 28 * 28, 128),
+    (128 * 28 * 28, 512),
+    (128 * 14 * 14, 256),
+    (128 * 14 * 14, 1024),
+    (128 * 7 * 7, 512),
+    (128 * 7 * 7, 2048),
+]
+
+
+def main() -> int:
+    stage = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_operator_tpu.ops import bn
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind}", flush=True)
+
+    def timed(label, fn, *args):
+        t0 = time.time()
+        out = jax.tree_util.tree_leaves(fn(*args))[0]
+        np.asarray(out.ravel()[:1])  # readback barrier (PERF.md timing note)
+        dt = time.time() - t0
+        print(f"  {label}: {dt:.1f}s", flush=True)
+        return dt
+
+    if stage == 1:
+        m, c = RESNET101_BN_SHAPES[0]
+        x = jnp.ones((m, c), jnp.bfloat16)
+        timed("bn_stats compile+run", jax.jit(bn.bn_stats), x)
+        print("PROBE_STAGE_OK 1", flush=True)
+
+    elif stage == 2:
+        m, c = RESNET101_BN_SHAPES[0]
+        x = jnp.ones((m // 56, 8, 7, c), jnp.bfloat16)  # 4-D like the model
+        g = jnp.ones((c,), jnp.float32)
+        b = jnp.zeros((c,), jnp.float32)
+
+        def loss(x, g, b):
+            y, mean, var = bn.fused_batch_norm(x, g, b, 1e-5)
+            return jnp.sum(y.astype(jnp.float32))
+
+        timed("fused_batch_norm fwd+bwd compile+run",
+              jax.jit(jax.grad(loss, argnums=(0, 1, 2))), x, g, b)
+        print("PROBE_STAGE_OK 2", flush=True)
+
+    elif stage == 3:
+        for m, c in RESNET101_BN_SHAPES:
+            x = jnp.ones((m, c), jnp.bfloat16)
+            timed(f"bn_stats[{m}x{c}]", jax.jit(bn.bn_stats), x)
+        print("PROBE_STAGE_OK 3", flush=True)
+
+    elif stage == 4:
+        xs = [jnp.ones((m, c), jnp.bfloat16) for m, c in RESNET101_BN_SHAPES]
+
+        @jax.jit
+        def all_in_one(xs):
+            return [bn.bn_stats(x) for x in xs]
+
+        timed("all shapes in one program", all_in_one, xs)
+        print("PROBE_STAGE_OK 4", flush=True)
+
+    elif stage == 5:
+        m, c = RESNET101_BN_SHAPES[1]  # 401408 x 256: biggest traffic
+        x = jnp.ones((m, c), jnp.bfloat16)
+
+        def xla_stats(x):
+            xf = x.astype(jnp.float32)
+            return jnp.sum(xf, 0), jnp.sum(xf * xf, 0)
+
+        jp = jax.jit(bn.bn_stats)
+        jx = jax.jit(xla_stats)
+        timed("pallas compile", jp, x)
+        timed("xla compile", jx, x)
+        for label, fn in (("pallas", jp), ("xla", jx)):
+            t0 = time.time()
+            n = 50
+            for _ in range(n):
+                out = fn(x)
+            np.asarray(out[0].ravel()[:1])
+            per = (time.time() - t0) / n * 1e3
+            gbps = (m * c * 2) / (per / 1e3) / 1e9
+            print(f"  {label}: {per:.2f} ms/call ~ {gbps:.0f} GB/s read",
+                  flush=True)
+        print("PROBE_STAGE_OK 5", flush=True)
+
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -609,6 +609,19 @@ class TPUJobController:
             return backoff is None or wstatus.restarts < backoff
 
         restarted: list[str] = []
+
+        def delete_for_restart(name: str, reason: str) -> None:
+            """Shared restart bookkeeping for the cached and the
+            AlreadyExists-adopt paths: delete + backoff accounting +
+            Restarting-condition material."""
+            try:
+                self.kube.pods(job.namespace).delete(name)
+            except NotFoundError:
+                pass
+            if reason.startswith("failed"):
+                wstatus.restarts += 1  # counts against backoffLimit
+            restarted.append(f"{name} ({reason})")
+
         for i in range(replicas):
             name = builders.worker_name(job, i)
             pod = self.pod_informer.lister.get(job.namespace, name)
@@ -636,13 +649,7 @@ class TPUJobController:
                     if fresh is None:
                         pod = None  # already gone; recreate below
                     elif reason is not None:
-                        try:
-                            self.kube.pods(job.namespace).delete(name)
-                        except NotFoundError:
-                            pass
-                        if reason.startswith("failed"):
-                            wstatus.restarts += 1  # counts against backoffLimit
-                        restarted.append(f"{name} ({reason})")
+                        delete_for_restart(name, reason)
                         pod = None  # recreate below with fresh rendezvous env
                     else:
                         pod = fresh  # cache was stale; pod is already correct
@@ -655,9 +662,26 @@ class TPUJobController:
                     )
                 except AlreadyExistsError:
                     # Stale cache (see _get_or_create_service docstring).
+                    # The adopted pod is live apiserver state, so the same
+                    # restart gate the cached path applies runs here too —
+                    # a stale-world-size or failed pod must not survive
+                    # adoption for a sync period.
                     pod = self._read_through_adopt(
                         self.kube.pods(job.namespace), job, name
                     )
+                    reason = self._elastic_restart_reason(
+                        job, pod, replicas,
+                        allow_failure_restart=may_restart_failed(),
+                    )
+                    if reason is not None:
+                        delete_for_restart(name, reason)
+                        pod = (
+                            self.kube.pods(job.namespace)
+                            .create(builders.new_worker(
+                                job, i, self.gang_scheduler_name
+                            ))
+                            .to_dict()
+                        )
                 except Exception as e:
                     self.recorder.eventf(
                         job,

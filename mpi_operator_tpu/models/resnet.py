@@ -210,9 +210,16 @@ def flops_per_image(depth: int, image_size: int = 224) -> float:
 
 
 def create_train_state(model: ResNet, rng, image_size: int = 224, batch: int = 8):
-    """Init params + batch stats with a dummy batch."""
-    variables = model.init(
-        rng, jnp.zeros((batch, image_size, image_size, 3), jnp.float32), train=True
+    """Init params + batch stats with a dummy batch.
+
+    The init runs under jit: eager init executes every op individually,
+    which with ``bn_impl="pallas"`` means ~one remote Mosaic compile per
+    BN layer on tunnel-attached TPUs (~100 round-trips; this hung a
+    round-3 bench capture for 29+ minutes before being killed). One
+    jitted program is one compile."""
+    init = jax.jit(partial(model.init, train=True))
+    variables = init(
+        rng, jnp.zeros((batch, image_size, image_size, 3), jnp.float32)
     )
     return variables["params"], variables["batch_stats"]
 

@@ -356,6 +356,13 @@ def _flash_fwd_impl(
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
+        # (batch·head, q-block) programs are independent; only the kv
+        # axis carries the accumulator. Declaring that lets Mosaic
+        # split the parallel axes across megacore (v5p) and schedule
+        # the pipeline without cross-iteration hazards.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(*operands)
     return out[:, :q_len], lse[:, 0, :q_len]
@@ -416,6 +423,9 @@ def _flash_bwd_impl(
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype, vma=jax.typeof(qp).vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(*operands, *id_operands)
 
@@ -454,6 +464,11 @@ def _flash_bwd_impl(
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
+        # Accumulation runs over the innermost (q-block × group) axis;
+        # kv-head and kv-block programs are independent.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(*operands, *id_operands)
 

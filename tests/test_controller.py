@@ -775,3 +775,29 @@ class TestStaleCacheCreateRace:
             f.controller.sync_handler("default/test-job")
         got = f.api.get("configmaps", "default", "test-job-config")
         assert got["data"] == {"foreign": "yes"}  # untouched
+
+    def test_adopted_pod_with_stale_world_size_is_restarted(self):
+        # Elastic resize while the pod informer misses the pod: the
+        # AlreadyExists read-through must apply the same restart gate the
+        # cached path does — the old-world-size pod is replaced, not
+        # adopted as-is.
+        f = Fixture()
+        job = f.new_job(workers=4)
+        f.start()
+        created = f.create_job(job)
+        f.sync(created)
+        # Scale 1 -> 2 slices (4 -> 8 workers on v5e-16).
+        live = f.get_job()
+        live.spec.tpu.num_slices = 2
+        live.spec.replica_specs[REPLICA_TYPE_WORKER].replicas = 8
+        f.controller.tpujobs.tpujobs("default").update(live)
+        f.controller.factory.pump_until_quiet()
+        # Hide worker-0 from the pod cache (lags the apiserver): the sync
+        # takes the create -> AlreadyExists -> read-through path for it.
+        del f.controller.pod_informer._cache["default/test-job-worker-0"]
+        f.controller.sync_handler("default/test-job")
+        pod = f.api.get("pods", "default", "test-job-worker-0")
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["TPUJOB_NUM_PROCESSES"] == "8"
+        assert st.has_condition(f.get_job().status, "Restarting")
