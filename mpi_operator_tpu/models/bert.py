@@ -128,13 +128,12 @@ class Bert(nn.Module):
         )(h)
         h = nn.gelu(h)
         h = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="mlm_norm")(h)
-        # Explicit f32 matmul for the tied decoder: Embed.attend promotes
-        # operands to the module dtype (bf16), losing the f32 logits.
-        return jnp.dot(
-            h.astype(jnp.float32),
-            embed.embedding.astype(jnp.float32).T,
-            preferred_element_type=jnp.float32,
-        )
+        # Tied decoder with f32 accumulation but compute-dtype operands
+        # (ops/losses.py:f32_logits rationale); Embed.attend would round
+        # the accumulation back to bf16.
+        from ..ops.losses import f32_logits
+
+        return f32_logits(h, embed.embedding.T)
 
 
 def init_params(model: Bert, rng, batch: int = 2, seq: int = 16):

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import NEG_INF
+from ..ops.losses import f32_logits
 from .llama import LlamaConfig, _rope
 
 
@@ -131,7 +132,10 @@ def _decode_step(params, cfg: LlamaConfig, caches, token, pos):
         w = params["embed"]["embedding"].T
     else:
         w = params["lm_head"]["kernel"]
-    logits = x.astype(jnp.float32) @ w.astype(jnp.float32)
+    # Head operands in the model's compute dtype with f32 accumulation
+    # (ops/losses.py:f32_logits): halves the [D, V] weight read in bf16
+    # configs; tiny test configs (dtype=f32) are numerically unchanged.
+    logits = f32_logits(x.astype(cfg.dtype), w)
     return logits, new_caches
 
 

@@ -155,6 +155,27 @@ def _rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
+class F32LogitsDense(nn.Module):
+    """Bias-free projection producing f32 logits from compute-dtype
+    operands: the kernel lives in f32 (param tree identical to
+    ``nn.Dense(name=...)`` — {name: {kernel}}), the matmul runs with
+    operands in the input's dtype and ``preferred_element_type=f32``.
+    ``nn.Dense(dtype=f32)`` would instead promote BOTH operands to f32,
+    which the TPU MXU executes as multiple passes, several x slower."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.losses import f32_logits
+
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features), jnp.float32,
+        )
+        return f32_logits(x, kernel)
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-5
 
@@ -318,20 +339,19 @@ class Llama(nn.Module):
         if return_hidden:
             return h, aux_total
         # Untied lm_head (Llama-3 does not tie embeddings); f32 logits for
-        # a stable softmax-CE.
+        # a stable softmax-CE. Operands stay in the compute dtype (bf16
+        # in production) with f32 ACCUMULATION — an f32xf32 matmul runs
+        # as multiple MXU passes on TPU, several x slower, for precision
+        # the f32 accumulator already provides.
         if cfg.tie_embeddings:
-            # Explicit f32 matmul: Embed.attend would promote back to the
-            # module dtype (bf16) and silently drop the f32 guarantee.
-            logits = jnp.dot(
-                h.astype(jnp.float32),
-                emb.embedding.astype(jnp.float32).T,
-                preferred_element_type=jnp.float32,
-            )
+            # Explicit dot (ops/losses.py:f32_logits): Embed.attend would
+            # cast the f32 accumulation back to the module dtype and drop
+            # the f32 logits guarantee.
+            from ..ops.losses import f32_logits
+
+            logits = f32_logits(h, emb.embedding.T)
         else:
-            logits = nn.Dense(
-                cfg.vocab_size, use_bias=False, dtype=jnp.float32,
-                param_dtype=jnp.float32, name="lm_head",
-            )(h.astype(jnp.float32))
+            logits = F32LogitsDense(cfg.vocab_size, name="lm_head")(h)
         # MoE configs also hand back the summed router aux loss; dense
         # callers keep the plain-logits contract.
         return (logits, aux_total) if cfg.is_moe else logits

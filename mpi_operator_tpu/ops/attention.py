@@ -297,6 +297,41 @@ def _pad_ids(ids, multiple: int, fill: int):
     return ids.astype(jnp.int32).reshape(1, -1)
 
 
+def _kv_clamp(active: bool, q_len: int, kv_len: int,
+              block_q: int, block_k: int):
+    """kv-block index clamp for causal dead-block DMA elimination (see
+    the fwd in_specs comment). Identity when inactive."""
+    if not active:
+        return lambda i, j: j
+    off = kv_len - q_len  # aligned-causal end offset (_block_mask)
+
+    def clamp(i, j):
+        last_live = (i * block_q + (block_q - 1) + off) // block_k
+        return jnp.minimum(j, jnp.maximum(last_live, 0))
+
+    return clamp
+
+
+def _q_clamp(active: bool, q_len: int, kv_len: int,
+             block_q: int, block_k: int, nq: int):
+    """q-block index clamp for the dkv grid (dead early q blocks of each
+    kv block re-address the first live one). Identity when inactive."""
+    if not active:
+        return lambda j, e: e % nq
+
+    off = kv_len - q_len
+
+    def clamp(j, e):
+        # q block qb is live for kv block j iff
+        #   qb*bq + bq-1 + off >= j*bk  <=>  qb >= ceil((j*bk-off-bq+1)/bq)
+        # and that integer ceil is (j*bk - off) // bq.
+        first_live = (j * block_k - off) // block_q
+        lo = jnp.clip(first_live, 0, nq - 1)
+        return jnp.maximum(e % nq, lo)
+
+    return clamp
+
+
 def _flash_fwd_impl(
     q, k, v, row_ids, col_ids, sm_scale, causal, block_q, block_k, interpret
 ):
@@ -316,10 +351,18 @@ def _flash_fwd_impl(
         sm_scale=sm_scale, causal=causal, use_ids=use_ids,
         q_len=q_len, kv_len=kv_len, block_q=block_q, block_k=block_k,
     )
+    # Causal (static-mask) runs clamp the kv block index at the last
+    # live block of each q row: dead iterations re-address the block the
+    # pipeline already holds, so Mosaic's revisit detection skips their
+    # DMA entirely (the `live` predicate already skips their MXU work).
+    # The upper triangle is ~half of all (i, j) pairs — that traffic is
+    # pure waste otherwise. Id-based runs (ring hops) keep the plain map:
+    # their live set is data-dependent.
+    jc = _kv_clamp(causal and not use_ids, q_len, kv_len, block_q, block_k)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, jc(i, j), 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, jc(i, j), 0)),
     ]
     operands = [qp, kp, vp]
     if use_ids:
@@ -403,10 +446,12 @@ def _flash_bwd_impl(
             _pad_ids(row_ids, block_q, -_ID_PAD),
             _pad_ids(col_ids, block_k, _ID_PAD),
         ]
+    # Same dead-block DMA clamps as the forward (see its in_specs note).
+    jc = _kv_clamp(causal and not use_ids, q_len, kv_len, block_q, block_k)
     dq_in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, jc(i, j), 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // groups, jc(i, j), 0)),
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
@@ -435,13 +480,16 @@ def _flash_bwd_impl(
     def qrow(b, e):
         return b * groups + e // nq
 
+    # Dead early q blocks of each kv block re-address the first live one
+    # (zero DMA via revisit detection; compute already skipped).
+    ec = _q_clamp(causal and not use_ids, q_len, kv_len, block_q, block_k, nq)
     dkv_in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, j, e: (qrow(b, e), e % nq, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, e: (qrow(b, e), ec(j, e), 0)),
         pl.BlockSpec((1, block_k, d), lambda b, j, e: (b, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, j, e: (b, j, 0)),
-        pl.BlockSpec((1, block_q, d), lambda b, j, e: (qrow(b, e), e % nq, 0)),
-        pl.BlockSpec((1, 1, block_q), lambda b, j, e: (qrow(b, e), 0, e % nq)),
-        pl.BlockSpec((1, 1, block_q), lambda b, j, e: (qrow(b, e), 0, e % nq)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, e: (qrow(b, e), ec(j, e), 0)),
+        pl.BlockSpec((1, 1, block_q), lambda b, j, e: (qrow(b, e), 0, ec(j, e))),
+        pl.BlockSpec((1, 1, block_q), lambda b, j, e: (qrow(b, e), 0, ec(j, e))),
     ]
     if use_ids:
         dkv_in_specs += [

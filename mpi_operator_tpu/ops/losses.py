@@ -22,12 +22,23 @@ import jax.numpy as jnp
 import optax
 
 
+def f32_logits(h, w):
+    """``h @ w`` with operands in h's compute dtype and **f32
+    accumulation** — the logits idiom every head shares. An f32xf32
+    matmul decomposes into multiple MXU passes on TPU (several x
+    slower) for precision the f32 accumulator already provides; bf16
+    operands with ``preferred_element_type=f32`` run at full MXU rate
+    and keep f32 logits for a stable softmax/CE."""
+    return jnp.dot(h, w.astype(h.dtype), preferred_element_type=jnp.float32)
+
+
 def lm_xent_chunked(h, w, targets, weights=None, *, chunk: int = 512):
     """Mean cross-entropy of ``softmax(h @ w)`` against ``targets``,
     computed ``chunk`` sequence positions at a time.
 
     h: [B, S, D] hidden states (any float dtype; logits are f32).
-    w: [D, V] head kernel (f32 recommended).
+    w: [D, V] head kernel (stored f32; the matmul runs with operands
+    cast to h.dtype and f32 accumulation — full-rate MXU in bf16).
     targets: [B, S] int labels.
     weights: optional [B, S] float mask; defaults to all-ones. The
     result is sum(ce * weights) / max(sum(weights), 1) — identical to
@@ -47,6 +58,10 @@ def lm_xent_chunked(h, w, targets, weights=None, *, chunk: int = 512):
         targets = jnp.pad(targets, ((0, 0), (0, pad)))
         weights = jnp.pad(weights, ((0, 0), (0, pad)))
     n = (s + pad) // chunk
+    # Cast once, outside the scan: inside the checkpointed chunk body the
+    # [D, V] kernel would be re-converted per chunk on forward AND on
+    # every backward recompute (GBs of pure convert traffic at 8B scale).
+    w = w.astype(h.dtype)
 
     # [n, B, chunk, ...] so the scan walks sequence chunks.
     h_c = h.reshape(b, n, chunk, d).transpose(1, 0, 2, 3)
@@ -55,11 +70,9 @@ def lm_xent_chunked(h, w, targets, weights=None, *, chunk: int = 512):
 
     @jax.checkpoint
     def chunk_loss(hc, tc, wc):
-        logits = jnp.dot(
-            hc.astype(jnp.float32), w.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            f32_logits(hc, w), tc
         )
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
         return jnp.sum(ce * wc)
 
     def body(acc, xs):

@@ -19,7 +19,9 @@ def _setup(b=2, s=24, d=16, v=64, seed=0):
 
 
 def _oracle(h, w, t, weights=None):
-    logits = h.astype(jnp.float32) @ w.astype(jnp.float32)
+    # Same contract as lm_xent_chunked: the matmul runs with operands in
+    # h's dtype (bf16 in production — full-rate MXU) and f32 accumulation.
+    logits = jnp.dot(h, w.astype(h.dtype), preferred_element_type=jnp.float32)
     ce = optax.softmax_cross_entropy_with_integer_labels(logits, t)
     if weights is None:
         return jnp.mean(ce)
