@@ -275,7 +275,8 @@ def bench_bert(args) -> dict:
     mesh = create_mesh(dp=-1)  # data-parallel over every chip
     seq_len = args.seq_len or 512
     cfg = bert_lib.bert_base(
-        flash_block_q=args.flash_block_q, flash_block_k=args.flash_block_k
+        flash_block_q=args.flash_block_q, flash_block_k=args.flash_block_k,
+        attention_impl=args.attention_impl,
     )
     model = bert_lib.Bert(cfg)
     params = bert_lib.init_params(
@@ -373,6 +374,7 @@ def bench_llama(args) -> dict:
         remat_policy=args.remat_policy,
         # Chunked head+CE: the [B, S, 32768] f32 logits never materialize.
         xent_chunk=args.xent_chunk,
+        attention_impl=args.attention_impl,
         # On-hardware tuning surface for the >=50% MFU push.
         flash_block_q=args.flash_block_q,
         flash_block_k=args.flash_block_k,
@@ -579,6 +581,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="flash attention q-tile (bert/llama suites)")
     parser.add_argument("--flash-block-k", type=int, default=128,
                         help="flash attention k-tile (bert/llama suites)")
+    parser.add_argument("--attention-impl", choices=["flash", "dense"],
+                        default="flash",
+                        help="bert/llama suites: pallas flash kernel or "
+                             "XLA dense attention (materialized scores) — "
+                             "the hardware A/B for kernel-vs-compiler")
     parser.add_argument("--no-s2d", action="store_true",
                         help="disable the space-to-depth ResNet stem "
                              "(the MLPerf TPU transform; on by default)")

@@ -44,7 +44,9 @@ def ns(**kw) -> argparse.Namespace:
 LLAMA_SWEEP = [
     # name, overrides — ordered so the most informative A/Bs come first.
     ("base-b4-dots-fb128", {}),
+    ("dense-attn", {"attention_impl": "dense"}),
     ("fb256", {"flash_block_q": 256, "flash_block_k": 256}),
+    ("fb512", {"flash_block_q": 512, "flash_block_k": 512}),
     ("fb512q-256k", {"flash_block_q": 512, "flash_block_k": 256}),
     ("full-remat-b8", {"remat_policy": "full", "llama_batch": 8}),
     ("full-remat-b4", {"remat_policy": "full"}),
@@ -56,7 +58,9 @@ LLAMA_SWEEP = [
 
 BERT_SWEEP = [
     ("base-b64-fb128", {"suite": "bert"}),
+    ("dense-attn", {"suite": "bert", "attention_impl": "dense"}),
     ("fb256", {"suite": "bert", "flash_block_q": 256, "flash_block_k": 256}),
+    ("fb512", {"suite": "bert", "flash_block_q": 512, "flash_block_k": 512}),
     ("b128", {"suite": "bert", "bert_batch": 128}),
     ("b256", {"suite": "bert", "bert_batch": 256}),
     ("b128-fb256", {"suite": "bert", "bert_batch": 128,

@@ -75,6 +75,33 @@ class TestFlashAttention:
                 got, want, atol=5e-4, rtol=1e-3, err_msg=f"d{name} mismatch"
             )
 
+    @pytest.mark.parametrize("sq,sk,bq,bk", [
+        (256, 256, 64, 128),   # mismatched tiles
+        (200, 200, 128, 64),   # non-divisible seq (padding + clamp)
+        (128, 256, 64, 64),    # causal cross lengths (off != 0)
+    ])
+    def test_causal_gradients_across_tilings(self, sq, sk, bq, bk):
+        """The dead-block DMA clamps rewrite the bwd kv/q index maps as a
+        function of tile sizes — causal gradients must stay equal to the
+        dense reference for every tiling, padding, and length offset."""
+        q, k, v = _qkv(sq=sq, sk=sk, d=64)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=True,
+                                block_q=bq, block_k=bk) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for got, want, name in zip(g_flash, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                got, want, atol=5e-4, rtol=1e-3, err_msg=f"d{name} mismatch"
+            )
+
     def test_bf16_inputs(self):
         q, k, v = _qkv(dtype=jnp.bfloat16)
         out = flash_attention(q, k, v)
