@@ -133,7 +133,7 @@ class MoEMLP(nn.Module):
         )
         dispatch, combine, aux = routing(probs, self.top_k, cap)
         dispatch = dispatch.astype(self.dtype)
-        combine = combine.astype(jnp.float32)
+        combine = combine.astype(self.dtype)  # see the combine einsum note
 
         init = nn.initializers.lecun_normal(batch_axis=(0,))
         w_gate = self.param("expert_wg", init, (e, d, f), jnp.float32)
@@ -171,8 +171,14 @@ class MoEMLP(nn.Module):
         )
 
         # All-to-all back: experts-sharded rows → groups-sharded tokens.
+        # Compute-dtype operands with f32 ACCUMULATION (the
+        # ops/losses.py:f32_logits rationale): an f32xf32 einsum of this
+        # size runs as multiple MXU passes. Each output row sums at most
+        # top_k weighted terms, so bf16-rounding the combine weights
+        # perturbs the (bf16) output below its own rounding step.
         out = jnp.einsum(
-            "gsec,egcd->gsd", combine, expert_out.astype(jnp.float32)
+            "gsec,egcd->gsd", combine, expert_out,
+            preferred_element_type=jnp.float32,
         )
         return out.astype(x.dtype), aux
 
