@@ -1,0 +1,155 @@
+"""Held-out evaluation (loss / perplexity) from a trainer checkpoint.
+
+Closes the train → eval loop the same way cmd.generate closes
+train → decode: read the newest orbax checkpoint cmd.train wrote,
+stream a pre-tokenized corpus through the model WITHOUT an optimizer,
+and print one JSON line with the token-weighted mean cross-entropy and
+perplexity. The reference universe leaves evaluation entirely to user
+images (SURVEY.md §2.3); here it is one command against the same
+artifacts and data format the trainer uses.
+
+    python -m mpi_operator_tpu.cmd.eval \
+        --checkpoint-dir /ckpt/llama --model llama-tiny \
+        --data corpus.u32 --batch 8 --batches 50 [--mesh dp=2,tp=2]
+
+Token IDs in — tokenizers are corpus-specific and out of scope
+(data/loader.py reads pre-tokenized uint32 streams).
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpujob-eval",
+        description="held-out loss/perplexity from a cmd.train checkpoint",
+    )
+    p.add_argument("--checkpoint-dir", required=True)
+    p.add_argument("--model", default="llama-tiny",
+                   help="llama3-8b|llama-tiny|mixtral-8x7b|llama-moe-tiny "
+                        "(must match the training run)")
+    p.add_argument("--data", required=True,
+                   help="binary little-endian uint32 token file "
+                        "(data/loader.py format, same as cmd.train --data)")
+    p.add_argument("--batch", type=int, default=8, help="global batch size")
+    p.add_argument("--batches", type=int, default=0,
+                   help="number of batches to evaluate (0 = one full "
+                        "epoch of distinct sequences)")
+    p.add_argument("--seq-len", type=int, default=0,
+                   help="sequence length (0 = the model's max_seq_len)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="epoch-shuffle seed (fixed seed = fixed eval set)")
+    p.add_argument("--mesh", default="",
+                   help="axis=size pairs (dp/fsdp/tp) to shard the eval "
+                        "across devices (empty = single device)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.batch < 1:
+        raise SystemExit("--batch must be >= 1")
+    if args.batches < 0:
+        raise SystemExit("--batches must be >= 0 (0 = one full epoch)")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..data.loader import TokenDataset
+    from ..models import llama as llama_lib
+    from ..utils.checkpoint import read_llama_params
+
+    try:
+        cfg = llama_lib.config_for(args.model)
+    except KeyError:
+        raise SystemExit(f"unknown --model {args.model!r} (llama family only)")
+    seq_len = args.seq_len or cfg.max_seq_len
+    if seq_len > cfg.max_seq_len:
+        raise SystemExit(
+            f"--seq-len {seq_len} exceeds the model context {cfg.max_seq_len}"
+        )
+
+    step, params = read_llama_params(args.checkpoint_dir, cfg, args.model)
+
+    ds = TokenDataset(args.data, seq_len, seed=args.seed)
+    n_batches = args.batches or max(1, ds.num_sequences // args.batch)
+
+    model = llama_lib.Llama(cfg)
+    ctx = contextlib.nullcontext()
+    mesh = None
+    if args.mesh:
+        from ..parallel import create_mesh, shard_params
+        from .train import parse_mesh_spec
+
+        sizes = parse_mesh_spec(args.mesh)
+        bad = [a for a, n in sizes.items()
+               if a not in ("dp", "fsdp", "tp") and n > 1]
+        if bad:
+            raise SystemExit(
+                f"eval meshes take dp/fsdp/tp; {bad} have no eval-time "
+                f"meaning here"
+            )
+        mesh = create_mesh(**sizes)
+        batch_shards = 1
+        for a in ("dp", "fsdp"):
+            batch_shards *= dict(
+                zip(mesh.axis_names, mesh.devices.shape)
+            ).get(a, 1)
+        if args.batch % batch_shards:
+            raise SystemExit(
+                f"--batch {args.batch} not divisible by the dp*fsdp "
+                f"shard count {batch_shards}"
+            )
+        params = shard_params(
+            params, mesh, rules=llama_lib.param_sharding_rules(mesh)
+        )
+        ctx = mesh
+
+    # Per-batch SUMMED loss and token count so the final number is the
+    # token-weighted mean over the whole eval set regardless of batch
+    # shape (loss_fn's per-batch mean would weight batches equally).
+    def batch_stats(params, tokens):
+        # include_aux=False: perplexity is pure CE; the MoE router
+        # load-balance regularizer is a training objective, not a
+        # model-quality number.
+        loss = llama_lib.loss_fn(model, params, tokens, include_aux=False)
+        n = jnp.float32((tokens.shape[1] - 1) * tokens.shape[0])
+        return loss * n, n
+
+    stats = jax.jit(batch_stats)
+    total = np.float64(0.0)
+    count = np.float64(0.0)
+    with ctx:
+        for b in range(n_batches):
+            rows = ds.rows(b, args.batch, 0, args.batch).astype(np.int32)
+            tokens = jnp.asarray(rows)
+            if mesh is not None:
+                # Shard the batch dim over dp/fsdp — without this every
+                # device would redundantly run the full batch.
+                from ..parallel import shard_batch
+
+                tokens = shard_batch(tokens, mesh)
+            loss_sum, n = stats(params, tokens)
+            total += float(loss_sum)
+            count += float(n)
+    ds.close()
+
+    mean = total / max(count, 1.0)
+    print(json.dumps({
+        "step": step,
+        "model": args.model,
+        "batches": n_batches,
+        "tokens": int(count),
+        "loss": round(mean, 6),
+        "perplexity": round(float(np.exp(mean)), 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

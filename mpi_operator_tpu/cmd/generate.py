@@ -78,7 +78,7 @@ def main(argv=None) -> int:
 
     from ..models import llama as llama_lib
     from ..models.generate import generate
-    from ..utils.checkpoint import CheckpointManager
+    from ..utils.checkpoint import read_llama_params
 
     try:
         cfg = llama_lib.config_for(args.model)
@@ -98,32 +98,9 @@ def main(argv=None) -> int:
             f"{total} exceeds the model context {cfg.max_seq_len}"
         )
 
-    ckpt = CheckpointManager(args.checkpoint_dir)
-    step, state = ckpt.read_latest()
-    if step is None:
-        raise SystemExit(f"no checkpoint found under {args.checkpoint_dir}")
-    if "params" not in state:
-        raise SystemExit(
-            f"checkpoint at step {step} has no 'params' entry — was it "
-            f"written by cmd.train?"
-        )
-    params = state["params"]
-    if "blocks" in params:
-        # A pp-mesh training run stores the stage-stacked layout
-        # {embed, blocks [P, L/P, ...], final_norm[, lm_head]}; unstack
-        # it into the layer_i form generate() walks rather than failing
-        # deep in the decode step with a KeyError.
-        from ..models.llama_pp import unstack_block_params
-
-        blocks = unstack_block_params(params["blocks"])
-        n_found = len(blocks)
-        if n_found != cfg.n_layers:
-            raise SystemExit(
-                f"pipelined checkpoint holds {n_found} layers but "
-                f"{args.model} has {cfg.n_layers} — wrong --model?"
-            )
-        params = {k: v for k, v in params.items() if k != "blocks"}
-        params.update(blocks)
+    # Shared loader (utils/checkpoint.py): newest step, 'params' presence
+    # check, pp stage-stacked layouts unstacked into layer_i form.
+    step, params = read_llama_params(args.checkpoint_dir, cfg, args.model)
 
     prompt = jnp.asarray(prompts, jnp.int32)  # [B, S0]
     rng = jax.random.PRNGKey(args.seed) if args.temperature > 0 else None

@@ -362,15 +362,20 @@ def init_params(model: Llama, rng, batch: int = 2, seq: int = 16):
     return model.init(rng, tokens)["params"]
 
 
-def loss_fn(model: Llama, params, tokens):
+def loss_fn(model: Llama, params, tokens, include_aux: bool = True):
     """Next-token cross-entropy (+ router aux loss for MoE configs). The
     full sequence goes through the model (keeping the length divisible by
     the sp axis for ring attention); the shift happens on the logits.
+
+    ``include_aux=False`` returns the pure CE — evaluation/perplexity
+    (cmd.eval) must not fold the load-balance regularizer into the
+    reported number. Training keeps the default.
 
     With ``cfg.xent_chunk > 0`` the head + CE run chunked
     (ops/losses.py:lm_xent_chunked): same masked mean, but the [B, S, V]
     f32 logits never materialize."""
     cfg = model.config
+    aux_coef = cfg.router_aux_coef if include_aux else 0.0
     if cfg.xent_chunk > 0:
         from ..ops.losses import lm_xent_chunked
 
@@ -382,7 +387,7 @@ def loss_fn(model: Llama, params, tokens):
         ce = lm_xent_chunked(
             h[:, :-1], w, tokens[:, 1:], chunk=cfg.xent_chunk
         )
-        return ce + cfg.router_aux_coef * (aux if cfg.is_moe else 0.0)
+        return ce + aux_coef * (aux if cfg.is_moe else 0.0)
     out = model.apply({"params": params}, tokens)
     if cfg.is_moe:
         logits, aux = out
@@ -391,7 +396,7 @@ def loss_fn(model: Llama, params, tokens):
     ce = optax.softmax_cross_entropy_with_integer_labels(
         logits[:, :-1], tokens[:, 1:]
     )
-    return jnp.mean(ce) + cfg.router_aux_coef * aux
+    return jnp.mean(ce) + aux_coef * aux
 
 
 def make_train_step(model: Llama, optimizer, accum_steps: int = 1):

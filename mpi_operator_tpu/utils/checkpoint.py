@@ -237,3 +237,33 @@ class CheckpointManager:
 
     def close(self) -> None:
         self._mgr.close()
+
+
+def read_llama_params(checkpoint_dir: str, cfg, model_name: str):
+    """Shared cmd.generate / cmd.eval checkpoint loader: newest step's
+    ``params`` as host arrays, with pp-mesh stage-stacked layouts
+    unstacked into the ``layer_i`` form the plain model walks. Raises
+    ``SystemExit`` with operator-facing messages (these are CLI tools).
+    Returns ``(step, params)``."""
+    ckpt = CheckpointManager(checkpoint_dir)
+    step, state = ckpt.read_latest()
+    if step is None:
+        raise SystemExit(f"no checkpoint found under {checkpoint_dir}")
+    if "params" not in state:
+        raise SystemExit(
+            f"checkpoint at step {step} has no 'params' entry — was it "
+            f"written by cmd.train?"
+        )
+    params = state["params"]
+    if "blocks" in params:
+        from ..models.llama_pp import unstack_block_params
+
+        blocks = unstack_block_params(params["blocks"])
+        if len(blocks) != cfg.n_layers:
+            raise SystemExit(
+                f"pipelined checkpoint holds {len(blocks)} layers but "
+                f"{model_name} has {cfg.n_layers} — wrong --model?"
+            )
+        params = {k: v for k, v in params.items() if k != "blocks"}
+        params.update(blocks)
+    return step, params
