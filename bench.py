@@ -276,7 +276,8 @@ def bench_bert(args) -> dict:
     seq_len = args.seq_len or 512
     cfg = bert_lib.bert_base(
         flash_block_q=args.flash_block_q, flash_block_k=args.flash_block_k,
-        attention_impl=args.attention_impl,
+        attention_impl=args.attention_impl, remat=args.bert_remat,
+        remat_policy=args.remat_policy,
     )
     model = bert_lib.Bert(cfg)
     params = bert_lib.init_params(
@@ -571,7 +572,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "adamw f32 state + remat=dots on a 16G v5e")
     parser.add_argument("--remat-policy", choices=["dots", "full"],
                         default="dots",
-                        help="llama suite: layer checkpoint policy "
+                        help="bert/llama suites: layer checkpoint policy "
                              "(dots = save matmul outputs; full = save "
                              "only layer boundaries, +~33%% FLOPs)")
     parser.add_argument("--xent-chunk", type=int, default=512,
@@ -581,6 +582,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="flash attention q-tile (bert/llama suites)")
     parser.add_argument("--flash-block-k", type=int, default=128,
                         help="flash attention k-tile (bert/llama suites)")
+    parser.add_argument("--bert-remat", action="store_true",
+                        help="bert suite: per-layer checkpoint (fits the "
+                             "large-batch MFU sweep points in HBM)")
     parser.add_argument("--attention-impl", choices=["flash", "dense"],
                         default="flash",
                         help="bert/llama suites: pallas flash kernel or "

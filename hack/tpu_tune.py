@@ -62,7 +62,7 @@ BERT_SWEEP = [
     ("fb256", {"suite": "bert", "flash_block_q": 256, "flash_block_k": 256}),
     ("fb512", {"suite": "bert", "flash_block_q": 512, "flash_block_k": 512}),
     ("b128", {"suite": "bert", "bert_batch": 128}),
-    ("b256", {"suite": "bert", "bert_batch": 256}),
+    ("b256-remat", {"suite": "bert", "bert_batch": 256, "bert_remat": True}),
     ("b128-fb256", {"suite": "bert", "bert_batch": 128,
                     "flash_block_q": 256, "flash_block_k": 256}),
 ]

@@ -40,6 +40,11 @@ class BertConfig:
     # the BERT suite) — pure scheduling knobs, outputs are invariant.
     flash_block_q: int = 128
     flash_block_k: int = 128
+    # Per-layer jax.checkpoint: BERT-base activations fit HBM at the
+    # stock batch so this defaults off; large-batch MFU sweeps
+    # (bench --bert-batch 256) turn it on to fit.
+    remat: bool = False
+    remat_policy: str = "dots"  # 'full' | 'dots' (llama.remat_policy_for)
 
 
 def bert_base(**overrides) -> BertConfig:
@@ -116,8 +121,15 @@ class Bert(nn.Module):
                 param_dtype=jnp.float32, name="type_embed",
             )(token_types)
         h = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="embed_norm")(h)
+        layer = EncoderLayer
+        if cfg.remat:
+            from .llama import remat_policy_for
+
+            layer = nn.remat(
+                EncoderLayer, static_argnums=(), policy=remat_policy_for(cfg)
+            )
         for i in range(cfg.n_layers):
-            h = EncoderLayer(cfg, self.mesh, name=f"layer_{i}")(h)
+            h = layer(cfg, self.mesh, name=f"layer_{i}")(h)
         if mlm_positions is not None:
             h = jnp.take_along_axis(
                 h, mlm_positions[..., None].astype(jnp.int32), axis=1

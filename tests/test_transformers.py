@@ -230,6 +230,27 @@ class TestBert:
             first = float(loss) if first is None else first
         assert float(loss) < first * 0.8, (first, float(loss))
 
+    def test_remat_value_equivalent(self):
+        """BERT's per-layer checkpoint (the large-batch bench knob) must
+        not change loss or gradients."""
+        rng = np.random.RandomState(0)
+        tokens = _tokens(rng, 2, 32, 128)
+        mask = jnp.asarray(rng.rand(2, 32) < 0.15, jnp.float32)
+        results = {}
+        for name, kw in [("none", dict(remat=False)),
+                         ("dots", dict(remat=True, remat_policy="dots"))]:
+            cfg = bert_lib.tiny(**kw)
+            model = bert_lib.Bert(cfg)
+            params = bert_lib.init_params(model, jax.random.PRNGKey(0))
+            loss, grads = jax.jit(jax.value_and_grad(
+                lambda p, m=model: bert_lib.mlm_loss(m, p, tokens, mask, tokens)
+            ))(params)
+            results[name] = (float(loss), grads)
+        assert results["dots"][0] == pytest.approx(results["none"][0])
+        for a, b in zip(jax.tree_util.tree_leaves(results["none"][1]),
+                        jax.tree_util.tree_leaves(results["dots"][1])):
+            np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
     def test_positions_loss_matches_mask_loss(self):
         """Gathered-positions MLM loss == full-logits masked loss when
         the positions are exactly the masked slots."""
