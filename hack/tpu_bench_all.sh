@@ -57,9 +57,21 @@ run() {
 }
 
 run resnet101-s2d      --suite resnet --profile-dir /tmp/trace-resnet
-run resnet101-bn-pallas --suite resnet --bn-kernel pallas
 run bert-base          --suite bert --profile-dir /tmp/trace-bert
 run llama-0p7b         --suite llama --profile-dir /tmp/trace-llama
 run startup            --suite startup
+# Kernel-vs-compiler A/Bs (each isolates one hypothesis from the
+# round-3 MFU gap analysis; see docs/round3-notes.md).
+run bert-dense-attn    --suite bert --attention-impl dense
+run llama-dense-attn   --suite llama --attention-impl dense
+# BN pallas LAST: its ~100-kernel program hung the remote AOT compiler
+# for 29+ min in round 3 — run hack/bn_probe.py stages 1..5 first and
+# skip this if stage 4 stalls.
+python hack/bn_probe.py 1 && python hack/bn_probe.py 5 \
+  && run resnet101-bn-pallas --suite resnet --bn-kernel pallas
 
-echo "== done; commit $out and fold $md into PERF.md =="
+echo "== sweeps (in-process; every point appended to TUNE_CAPTURE.jsonl) =="
+python hack/tpu_tune.py llama --profile-best /tmp/trace-llama-best
+python hack/tpu_tune.py bert
+
+echo "== done; commit $out, TUNE_CAPTURE.jsonl, and fold $md into PERF.md =="
