@@ -114,18 +114,15 @@ def _timed_steps(step, state, args_rest, steps: int, warmup: int):
     return state, sec
 
 
-def _device_ms_per_step(profile_dir: str) -> float | None:
-    """Mean on-device ms per train step from the profiler's chrome trace
-    (the dominant 'XLA Modules' lane entry). Ground truth independent of
-    host-side sync semantics — logged next to the wall-clock number so a
-    tunnel-timing regression is visible immediately."""
+def _load_trace(profile_dir: str):
+    """(events, lane-name map) from the newest chrome trace under
+    ``profile_dir``, or (None, None) when no trace exists."""
     import glob
     import gzip
-    from collections import Counter
 
     paths = glob.glob(f"{profile_dir}/plugins/profile/*/*.trace.json.gz")
     if not paths:
-        return None
+        return None, None
     with gzip.open(max(paths), "rt") as f:
         tr = json.load(f)
     ev = tr.get("traceEvents", [])
@@ -134,6 +131,18 @@ def _device_ms_per_step(profile_dir: str) -> float | None:
         for e in ev
         if e.get("ph") == "M" and e.get("name") == "thread_name"
     }
+    return ev, lanes
+
+
+def _device_ms_per_step(ev, lanes) -> float | None:
+    """Mean on-device ms per train step from the profiler's chrome trace
+    (the dominant 'XLA Modules' lane entry). Ground truth independent of
+    host-side sync semantics — logged next to the wall-clock number so a
+    tunnel-timing regression is visible immediately."""
+    from collections import Counter
+
+    if ev is None:
+        return None
     tot, cnt = Counter(), Counter()
     for e in ev:
         if e.get("ph") == "X" and lanes.get((e["pid"], e["tid"])) == "XLA Modules":
@@ -143,6 +152,29 @@ def _device_ms_per_step(profile_dir: str) -> float | None:
         return None
     name, dur = tot.most_common(1)[0]
     return dur / 1e3 / cnt[name]  # µs -> ms, per execution
+
+
+def _trace_top_ops(ev, lanes, topn: int = 12) -> None:
+    """Log the top XLA ops by total device time from the trace — the
+    per-op breakdown that drives the MFU work, printed by the tool
+    itself so every profiled run leaves analyzable evidence."""
+    from collections import Counter
+
+    if ev is None:
+        return
+    tot, cnt = Counter(), Counter()
+    for e in ev:
+        lane = lanes.get((e.get("pid"), e.get("tid")), "")
+        if e.get("ph") == "X" and lane.startswith("XLA Ops"):
+            tot[e["name"]] += e.get("dur", 0)
+            cnt[e["name"]] += 1
+    grand = sum(tot.values())
+    if not grand:
+        return
+    log(f"top ops by device time ({grand / 1e3:.0f} ms total traced):")
+    for name, dur in tot.most_common(topn):
+        log(f"  {dur / grand * 100:5.1f}%  {dur / 1e3 / cnt[name]:8.3f} "
+            f"ms/exec x{cnt[name]:<5} {name[:80]}")
 
 
 def _param_count(params) -> int:
@@ -167,10 +199,12 @@ def _timed_steps_maybe_profiled(fn, state, args_rest, args):
     state, sec = _timed_steps(fn, state, args_rest, args.steps, 0)
     jax.profiler.stop_trace()
     log(f"profile written to {args.profile_dir}")
-    dev_ms = _device_ms_per_step(args.profile_dir)
+    ev, lanes = _load_trace(args.profile_dir)  # parsed once, shared
+    dev_ms = _device_ms_per_step(ev, lanes)
     if dev_ms:
         log(f"device time from trace: {dev_ms:.1f} ms/step "
             f"(wall-clock diff-quotient: {sec * 1e3:.1f})")
+    _trace_top_ops(ev, lanes)
     return state, sec
 
 
