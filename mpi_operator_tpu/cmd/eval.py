@@ -56,6 +56,13 @@ def main(argv=None) -> int:
     if args.batches < 0:
         raise SystemExit("--batches must be >= 0 (0 = one full epoch)")
 
+    # Join the TPUJob's jax.distributed world when run under the operator
+    # (idempotent; single-process runs skip it) — without this a
+    # multi-host eval job could never form its global mesh.
+    from ..launcher import bootstrap
+
+    bootstrap.initialize()
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -140,14 +147,15 @@ def main(argv=None) -> int:
     ds.close()
 
     mean = total / max(count, 1.0)
-    print(json.dumps({
-        "step": step,
-        "model": args.model,
-        "batches": n_batches,
-        "tokens": int(count),
-        "loss": round(mean, 6),
-        "perplexity": round(float(np.exp(mean)), 4),
-    }))
+    if jax.process_index() == 0:  # one JSON line per JOB, not per host
+        print(json.dumps({
+            "step": step,
+            "model": args.model,
+            "batches": n_batches,
+            "tokens": int(count),
+            "loss": round(mean, 6),
+            "perplexity": round(float(np.exp(mean)), 4),
+        }))
     return 0
 
 

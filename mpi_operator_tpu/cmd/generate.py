@@ -73,6 +73,13 @@ def main(argv=None) -> int:
     if args.max_new < 1:
         raise SystemExit("--max-new must be >= 1")
 
+    # Join the TPUJob's jax.distributed world when run under the operator
+    # (idempotent; single-process runs skip it) — a multi-host decode job
+    # cannot form its global mesh otherwise.
+    from ..launcher import bootstrap
+
+    bootstrap.initialize()
+
     import jax
     import jax.numpy as jnp
 
@@ -154,7 +161,10 @@ def main(argv=None) -> int:
             max_new=args.max_new, temperature=args.temperature, rng=rng,
         )
     # One JSON line per prompt, batch order preserved (a single prompt
-    # prints exactly what it always did).
+    # prints exactly what it always did). Multi-host jobs print from
+    # process 0 only — one output stream per JOB.
+    if jax.process_index() != 0:
+        return 0
     s0 = len(prompt_ids)
     for row, p in zip(out, prompts):
         tokens = [int(t) for t in row]

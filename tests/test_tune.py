@@ -1,0 +1,106 @@
+"""hack/tpu_tune.py — the in-process MFU sweep runner.
+
+A sweep bug costs a scarce hardware window, so the runner's contracts
+are pinned here with a stubbed bench: every config runs even when one
+raises, every result is appended to the JSONL as it lands, and the
+namespaces come from bench's own parser (drift guard).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_TUNE_PATH = os.path.join(os.path.dirname(__file__), "..", "hack", "tpu_tune.py")
+
+
+def _load_tune():
+    spec = importlib.util.spec_from_file_location("tpu_tune", _TUNE_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def tune():
+    return _load_tune()
+
+
+class TestNamespaces:
+    def test_ns_derives_from_bench_parser(self, tune):
+        ns = tune.ns()
+        # Spot-check representative defaults against bench's parser.
+        assert ns.suite == "resnet"
+        assert ns.llama_batch == 4
+        assert ns.steps == 20  # sweep shortening applied
+        assert ns.warmup == 2
+
+    def test_ns_rejects_unknown_override(self, tune):
+        with pytest.raises(AttributeError, match="unknown bench arg"):
+            tune.ns(not_a_flag=1)
+
+    def test_every_sweep_config_resolves(self, tune):
+        for name, ov in tune.LLAMA_SWEEP + tune.BERT_SWEEP:
+            tune.ns(**ov)  # must not raise
+
+
+class TestRunner:
+    def test_one_failure_does_not_lose_the_sweep(self, tune, monkeypatch,
+                                                 tmp_path):
+        n = len(tune.LLAMA_SWEEP)
+        ok = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 0.3}
+        seq = [MemoryError("OOM") if i == 1 else dict(ok) for i in range(n)]
+
+        def fake(args):
+            r = seq.pop(0)
+            if isinstance(r, Exception):
+                raise r
+            return r
+
+        monkeypatch.setattr(tune.bench, "bench_llama", fake)
+        out = tmp_path / "sweep.jsonl"
+        monkeypatch.setattr(
+            sys, "argv", ["tpu_tune.py", "llama", "--out", str(out)]
+        )
+        rc = tune.main()
+        assert rc == 0  # other configs succeeded
+        lines = [json.loads(line) for line in out.read_text().splitlines()]
+        assert len(lines) == n  # every config recorded, including the OOM
+        errors = [l for l in lines if "error" in l["result"]]
+        assert len(errors) == 1
+        assert errors[0]["result"]["error"] == "MemoryError"
+
+    def test_all_failures_exit_nonzero(self, tune, monkeypatch, tmp_path):
+        def fake(args):
+            raise RuntimeError("tunnel dead")
+
+        monkeypatch.setattr(tune.bench, "bench_bert", fake)
+        out = tmp_path / "sweep.jsonl"
+        monkeypatch.setattr(
+            sys, "argv", ["tpu_tune.py", "bert", "--out", str(out)]
+        )
+        assert tune.main() == 1
+
+    def test_results_append_incrementally(self, tune, monkeypatch, tmp_path):
+        """The JSONL must be written as results land (a crash mid-sweep
+        keeps earlier points), not in one dump at the end."""
+        out = tmp_path / "sweep.jsonl"
+        seen_counts = []
+
+        def fake(args):
+            if out.exists():
+                seen_counts.append(len(out.read_text().splitlines()))
+            else:
+                seen_counts.append(0)
+            return {"metric": "m", "value": 1.0, "unit": "u",
+                    "vs_baseline": 0.3}
+
+        monkeypatch.setattr(tune.bench, "bench_llama", fake)
+        monkeypatch.setattr(
+            sys, "argv", ["tpu_tune.py", "llama", "--quick", "--out", str(out)]
+        )
+        tune.main()
+        # Call i sees exactly i previously-written lines.
+        assert seen_counts == list(range(len(seen_counts)))
