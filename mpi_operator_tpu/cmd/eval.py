@@ -129,19 +129,44 @@ def main(argv=None) -> int:
         return loss * n, n
 
     stats = jax.jit(batch_stats)
+
+    def to_device(step: int):
+        """Batch ``step`` as a (possibly mesh-sharded) global array.
+
+        Single process: plain device_put (sharded over dp/fsdp when a
+        mesh is given — without that every device would redundantly run
+        the full batch). Multi-host: each process materializes exactly
+        the rows its local shards need via ``make_array_from_callback``
+        (the cmd.train discipline — ``device_put`` onto non-addressable
+        devices raises)."""
+        if mesh is None:
+            rows = ds.rows(step, args.batch, 0, args.batch).astype(np.int32)
+            return jnp.asarray(rows)
+        if jax.process_count() == 1:
+            from ..parallel import shard_batch
+
+            rows = ds.rows(step, args.batch, 0, args.batch).astype(np.int32)
+            return shard_batch(jnp.asarray(rows), mesh)
+        from jax.sharding import NamedSharding
+
+        from ..parallel.sharding import batch_spec
+
+        sharding = NamedSharding(mesh, batch_spec(mesh))
+
+        def cb(index):
+            lo, hi, _ = index[0].indices(args.batch)
+            r = ds.rows(step, args.batch, lo, hi).astype(np.int32)
+            return np.asarray(r[:, index[1]], np.int32)
+
+        return jax.make_array_from_callback(
+            (args.batch, seq_len), sharding, cb
+        )
+
     total = np.float64(0.0)
     count = np.float64(0.0)
     with ctx:
         for b in range(n_batches):
-            rows = ds.rows(b, args.batch, 0, args.batch).astype(np.int32)
-            tokens = jnp.asarray(rows)
-            if mesh is not None:
-                # Shard the batch dim over dp/fsdp — without this every
-                # device would redundantly run the full batch.
-                from ..parallel import shard_batch
-
-                tokens = shard_batch(tokens, mesh)
-            loss_sum, n = stats(params, tokens)
+            loss_sum, n = stats(params, to_device(b))
             total += float(loss_sum)
             count += float(n)
     ds.close()

@@ -161,8 +161,14 @@ def main(argv=None) -> int:
             max_new=args.max_new, temperature=args.temperature, rng=rng,
         )
     # One JSON line per prompt, batch order preserved (a single prompt
-    # prints exactly what it always did). Multi-host jobs print from
-    # process 0 only — one output stream per JOB.
+    # prints exactly what it always did). Multi-host jobs gather the
+    # (possibly batch-sharded) rows to every host, then print from
+    # process 0 only — one output stream per JOB. Iterating a
+    # non-fully-addressable array directly would raise.
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        out = multihost_utils.process_allgather(out, tiled=True)
     if jax.process_index() != 0:
         return 0
     s0 = len(prompt_ids)

@@ -224,13 +224,35 @@ class CheckpointManager:
 
     def read_latest(self) -> tuple[Optional[int], Any]:
         """Inspection/tooling path: read the newest checkpoint as plain
-        fully-replicated host arrays, with no sharding template. NOT for
-        training resume (no shardings, whole state on every host) — use
-        :meth:`restore_latest` there."""
+        host numpy arrays, with no sharding template. NOT for training
+        resume (no shardings, whole state on every host) — use
+        :meth:`restore_latest` there.
+
+        Restores explicitly as numpy: a bare ``restore(step)`` replays
+        the *stored* shardings, which fails whenever the reading
+        topology differs from the writing one — exactly the
+        cmd.generate / cmd.eval case (checkpoint written on one slice
+        shape, read on another, or on CPU)."""
+        import jax
+        import numpy as np
+
         step = self._mgr.latest_step()
         if step is None:
             return None, None
-        return step, self._mgr.restore(step)
+        meta = self._mgr.item_metadata(step)
+        # Metadata layout varies across orbax versions (the
+        # restore_latest path guards the same call): unwrap the tree
+        # attribute when present.
+        meta = getattr(meta, "tree", meta)
+        # numpy-leaf template → StandardCheckpointHandler restores each
+        # leaf as host numpy (np.zeros is calloc-lazy, so the template
+        # costs address space, not resident memory).
+        template = jax.tree_util.tree_map(
+            lambda m: np.zeros(m.shape, m.dtype), meta
+        )
+        return step, self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore(template)
+        )
 
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()

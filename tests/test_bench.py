@@ -59,3 +59,19 @@ class TestCaptureScript:
             capture_output=True, text=True,
         )
         assert out.returncode == 0, out.stderr
+
+
+class TestOperatorScaleSuite:
+    def test_reconciles_storm_and_reports_write_efficiency(self):
+        out = _run(["--suite", "operator-scale", "--scale-jobs", "40"])
+        assert out.returncode == 0, out.stderr[-800:] or out.stdout[-800:]
+        line = json.loads(out.stdout.strip().splitlines()[-1])
+        assert line["metric"] == "operator_reconcile_jobs_per_sec"
+        assert line["value"] > 1.0
+        # The no-churn evidence: writes/job is logged and must stay at
+        # the structural count (4 pods + svc + cm + ~2-3 status writes).
+        import re
+
+        m = re.search(r"writes/job = ([\d.]+)", out.stderr)
+        assert m, out.stderr[-500:]
+        assert float(m.group(1)) <= 12.0, out.stderr[-500:]
