@@ -1,0 +1,62 @@
+"""Shared scaffolding for real multi-process jax.distributed CLI tests.
+
+One place for the rendezvous env contract (a new required variable gets
+added here, not in every test) and for subprocess hygiene: a rank that
+wedges is killed on timeout instead of leaking past the test holding
+the coordinator port.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+from mpi_operator_tpu.utils.net import free_port_pair
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_distributed_cli(module: str, args, n: int = 2, timeout: int = 240):
+    """Run ``python -m module *args`` as ``n`` ranks of one
+    jax.distributed world (CPU backend, one local device per rank).
+    Returns a list of (returncode, stdout, stderr) per rank; asserts
+    nothing — callers own the contract checks."""
+    port = free_port_pair()  # reserves the gang-barrier side port too
+    procs = []
+    for rank in range(n):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+            XLA_FLAGS="",  # exactly one local device per process
+            TPUJOB_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            TPUJOB_NUM_PROCESSES=str(n),
+            TPUJOB_PROCESS_ID=str(rank),
+            TPU_WORKER_ID=str(rank),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", module, *args],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=_REPO,
+        ))
+    results = []
+    try:
+        for p in procs:
+            results.append((None, *p.communicate(timeout=timeout)))
+    finally:
+        for p in procs:  # a wedged rank must not outlive the test
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return [(p.returncode, so, se) for p, (_, so, se) in zip(procs, results)]
+
+
+def json_lines(results):
+    """Every stdout line that looks like a JSON object, across ranks."""
+    import json
+
+    return [
+        json.loads(line)
+        for _, so, _ in results for line in so.strip().splitlines()
+        if line.startswith("{")
+    ]

@@ -124,11 +124,7 @@ class TestEvalMultiProcess:
         checkpoint + corpus: the multi-host batch path
         (make_array_from_callback) must produce the single-device loss
         and exactly one JSON line (process 0)."""
-        import os
-        import subprocess
-        import sys
-
-        from mpi_operator_tpu.utils.net import free_port_pair
+        from tests.mphelpers import json_lines, run_distributed_cli
 
         ckpt = _train_ckpt(capsys, tmp_path)
         data = _write_corpus(tmp_path)
@@ -141,32 +137,13 @@ class TestEvalMultiProcess:
         eval_cmd.main(args)
         want = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
 
-        port = free_port_pair()
-        procs = []
-        for rank in range(2):
-            env = dict(
-                os.environ,
-                JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
-                XLA_FLAGS="",  # exactly one local device per process
-                TPUJOB_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-                TPUJOB_NUM_PROCESSES="2",
-                TPUJOB_PROCESS_ID=str(rank),
-                TPU_WORKER_ID=str(rank),
-            )
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "mpi_operator_tpu.cmd.eval",
-                 *args, "--mesh", "dp=2"],
-                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                text=True, cwd=os.path.dirname(os.path.dirname(__file__)),
-            ))
-        outs = [p.communicate(timeout=240) for p in procs]
-        for p, (so, se) in zip(procs, outs):
-            assert p.returncode == 0, se[-1200:]
-        json_lines = [
-            line for so, _ in outs for line in so.strip().splitlines()
-            if line.startswith("{")
-        ]
-        assert len(json_lines) == 1  # process 0 only
-        got = json.loads(json_lines[0])
+        results = run_distributed_cli(
+            "mpi_operator_tpu.cmd.eval", [*args, "--mesh", "dp=2"]
+        )
+        for rc, _, se in results:
+            assert rc == 0, se[-1200:]
+        lines = json_lines(results)
+        assert len(lines) == 1  # process 0 only
+        got = lines[0]
         assert got["tokens"] == want["tokens"]
         np.testing.assert_allclose(got["loss"], want["loss"], rtol=1e-5)

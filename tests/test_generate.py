@@ -333,3 +333,45 @@ class TestGenerate:
         got = generate(params, prompt, cfg, max_new=4)
         want = _greedy_reference(model, params, prompt, 4)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestGenerateMultiProcess:
+    def test_two_process_decode_matches_single(self, capsys, tmp_path):
+        """Two real subprocesses over jax.distributed (CPU backend, one
+        device each) run cmd.generate --mesh dp=2: tokens must match the
+        single-device decode and exactly one process prints."""
+        import json as _json
+
+        from mpi_operator_tpu.cmd import generate as gen_cmd
+        from tests.mphelpers import json_lines, run_distributed_cli
+        from tests.test_train import run_train
+
+        ckpt = str(tmp_path / "ckpt")
+        run_train(
+            capsys, "--model", "llama-tiny", "--steps", "2", "--warmup", "1",
+            "--global-batch", "8", "--seq-len", "16", "--log-every", "0",
+            "--checkpoint-dir", ckpt, "--save-every", "1",
+        )
+        args = [
+            "--checkpoint-dir", ckpt, "--model", "llama-tiny",
+            "--prompt", "12,7,42", "--prompt", "3,9,27",
+            "--max-new", "4",
+        ]
+        rc = gen_cmd.main(args)
+        assert rc == 0
+        want = [
+            _json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()
+            if line.startswith("{")
+        ]
+
+        results = run_distributed_cli(
+            "mpi_operator_tpu.cmd.generate", [*args, "--mesh", "dp=2"]
+        )
+        for rc_, _, se in results:
+            assert rc_ == 0, se[-1200:]
+        lines = json_lines(results)
+        assert len(lines) == len(want) == 2  # process 0 only, both prompts
+        for got, ref in zip(lines, want):
+            assert got["prompt"] == ref["prompt"]
+            assert got["tokens"] == ref["tokens"]
