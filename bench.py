@@ -228,12 +228,24 @@ def bench_resnet(args) -> dict:
     mesh = create_mesh(dp=-1, devices=devices)
 
     if args.bn_kernel == "pallas":
-        from mpi_operator_tpu.ops.bn import require_single_device
+        from mpi_operator_tpu.ops.bn import (
+            PALLAS_MIN_ELEMS,
+            require_single_device,
+        )
 
         require_single_device(n)
+        thresh = (PALLAS_MIN_ELEMS if args.bn_pallas_min_elems is None
+                  else args.bn_pallas_min_elems)
+        # The A/B is honest only if the reader knows the routing: layers
+        # under the threshold measure XLA, not the kernels.
+        log(f"bn=pallas routing: layers with >= {thresh:,} elements take "
+            f"the pallas kernels, smaller ones stay on XLA "
+            f"(--bn-pallas-min-elems 0 forces every layer)")
     s2d = not args.no_s2d and args.image_size % 2 == 0
     model = resnet_lib.resnet(
-        args.depth, space_to_depth=s2d, bn_impl=args.bn_kernel
+        args.depth, space_to_depth=s2d, bn_impl=args.bn_kernel,
+        scan_stages=args.scan_stages,
+        bn_pallas_min_elems=args.bn_pallas_min_elems,
     )
     rng = jax.random.PRNGKey(0)
     params, batch_stats = resnet_lib.create_train_state(
@@ -732,11 +744,26 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--no-s2d", action="store_true",
                         help="disable the space-to-depth ResNet stem "
                              "(the MLPerf TPU transform; on by default)")
+    parser.add_argument("--scan-stages", action="store_true",
+                        help="lax.scan the ResNet stages' repeated "
+                             "blocks: one compiled stage body instead of "
+                             "30 (pallas-BN kernel instances drop from "
+                             "~208 to ~16, making --bn-kernel pallas "
+                             "compile-neutral). Runtime A/B pending "
+                             "hardware; the default stays unrolled to "
+                             "protect the measured headline")
     parser.add_argument("--bn-kernel", choices=["xla", "pallas"],
                         default="xla",
                         help="BN reduction path: XLA's convert_reduce "
                              "fusions or the fused pallas stats/grads "
-                             "kernels (ops/bn.py; single-chip dp mesh)")
+                             "kernels (ops/bn.py; single-chip dp mesh). "
+                             "pallas is a size-gated hybrid — see "
+                             "--bn-pallas-min-elems")
+    parser.add_argument("--bn-pallas-min-elems", type=int, default=None,
+                        help="bn-kernel=pallas: layers below this element "
+                             "count stay on XLA reductions (default "
+                             "ops/bn.py:PALLAS_MIN_ELEMS; 0 = every BN "
+                             "layer through the kernels)")
     parser.add_argument("--scale-jobs", type=int, default=200,
                         help="operator-scale suite: size of the TPUJob "
                              "creation storm")

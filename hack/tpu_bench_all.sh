@@ -64,11 +64,14 @@ run startup            --suite startup
 # round-3 MFU gap analysis; see docs/round3-notes.md).
 run bert-dense-attn    --suite bert --attention-impl dense
 run llama-dense-attn   --suite llama --attention-impl dense
-# BN pallas LAST: its ~100-kernel program hung the remote AOT compiler
-# for 29+ min in round 3 — run hack/bn_probe.py stages 1..5 first and
-# skip this if stage 4 stalls.
+# ResNet A/Bs: scanned stages (compile-friendly form) and pallas BN.
+# Chipless-AOT analysis (docs/round3-notes.md) localized round 3's
+# 29-min "hang" to the eager-init kernel storm (fixed: init is jitted)
+# and measured scan+pallas compiling FASTER than plain xla — but run
+# the bn probe first anyway, and prefer the scan form for pallas.
+run resnet101-scan     --suite resnet --scan-stages
 python hack/bn_probe.py 1 && python hack/bn_probe.py 5 \
-  && run resnet101-bn-pallas --suite resnet --bn-kernel pallas
+  && run resnet101-bn-pallas-scan --suite resnet --bn-kernel pallas --scan-stages
 
 echo "== sweeps (in-process; every point appended to TUNE_CAPTURE.jsonl) =="
 python hack/tpu_tune.py llama --profile-best /tmp/trace-llama-best

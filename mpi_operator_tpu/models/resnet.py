@@ -13,7 +13,7 @@ Horovod/NCCL.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import flax.linen as nn
 import jax
@@ -84,6 +84,17 @@ class BasicBlock(nn.Module):
         return self.act(residual + y)
 
 
+class _ScanBody(nn.Module):
+    """Adapter giving a ResNet block the (carry, _) -> (carry, None)
+    shape ``nn.scan`` wants."""
+
+    inner: ModuleDef
+
+    @nn.compact
+    def __call__(self, x, _):
+        return self.inner()(x), None
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     bottleneck: bool = True
@@ -101,6 +112,18 @@ class ResNet(nn.Module):
     # BN reductions are half the train step (PERF.md); "pallas" routes
     # the stats and dγ/dβ passes through ops/bn.py's fused kernels.
     bn_impl: str = "xla"
+    # bn_impl="pallas" only: layers below this element count take XLA
+    # reductions (compile-time economics, ops/bn.py:PALLAS_MIN_ELEMS).
+    # 0 = every BN layer through the kernels.
+    bn_pallas_min_elems: Optional[int] = None
+    # lax.scan over each stage's identical blocks (all but the strided
+    # first one): the stage body compiles ONCE instead of per block —
+    # ResNet-101's 30 repeated blocks dominate both the XLA graph and,
+    # under bn_impl="pallas", the Mosaic kernel-instance count (each
+    # pallas_call instance costs ~1 s of compile with no dedup; measured
+    # via chipless AOT). Param layout changes: repeated blocks stack
+    # under "stage{i}_rest" with a leading [n] axis.
+    scan_stages: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -108,7 +131,15 @@ class ResNet(nn.Module):
             nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32
         )
         if self.bn_impl == "pallas":
-            from ..ops.bn import TpuBatchNorm as _BN
+            from ..ops.bn import PALLAS_MIN_ELEMS, TpuBatchNorm
+
+            _BN = partial(
+                TpuBatchNorm,
+                pallas_min_elems=(
+                    PALLAS_MIN_ELEMS if self.bn_pallas_min_elems is None
+                    else self.bn_pallas_min_elems
+                ),
+            )
         elif self.bn_impl == "xla":
             _BN = nn.BatchNorm
         else:
@@ -145,15 +176,30 @@ class ResNet(nn.Module):
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for i, block_count in enumerate(self.stage_sizes):
-            for j in range(block_count):
-                strides = 2 if i > 0 and j == 0 else 1
-                x = block(
-                    filters=self.num_filters * 2**i,
-                    strides=strides,
-                    conv=conv,
-                    norm=norm,
-                    act=nn.relu,
-                )(x)
+            mk = partial(
+                block, filters=self.num_filters * 2**i,
+                conv=conv, norm=norm, act=nn.relu,
+            )
+            if not self.scan_stages:
+                for j in range(block_count):
+                    x = mk(strides=2 if i > 0 and j == 0 else 1,
+                           name=f"stage{i}_block{j}")(x)
+                continue
+            # First block owns the stride + projection; the remaining
+            # identical blocks run as ONE scanned body.
+            x = mk(strides=2 if i > 0 else 1, name=f"stage{i}_block0")(x)
+            n_rest = block_count - 1
+            if n_rest:
+                scanned = nn.scan(
+                    _ScanBody,
+                    variable_axes={"params": 0, "batch_stats": 0},
+                    split_rngs={"params": True},
+                    length=n_rest,
+                    metadata_params={nn.PARTITION_NAME: None},
+                )
+                x, _ = scanned(
+                    inner=partial(mk, strides=1), name=f"stage{i}_rest"
+                )(x, None)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype, param_dtype=jnp.float32,
                      name="head")(x)
@@ -166,6 +212,8 @@ def resnet(
     dtype=jnp.bfloat16,
     space_to_depth: bool = False,
     bn_impl: str = "xla",
+    scan_stages: bool = False,
+    bn_pallas_min_elems: "Optional[int]" = None,
 ) -> ResNet:
     return ResNet(
         stage_sizes=STAGE_SIZES[depth],
@@ -174,6 +222,8 @@ def resnet(
         dtype=dtype,
         space_to_depth=space_to_depth,
         bn_impl=bn_impl,
+        scan_stages=scan_stages,
+        bn_pallas_min_elems=bn_pallas_min_elems,
     )
 
 

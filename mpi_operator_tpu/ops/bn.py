@@ -164,12 +164,10 @@ def _fbn_fwd_impl(x, gamma, beta, eps):
     # E[x²]−E[x]² (both moments from one read); clamp the catastrophic-
     # cancellation tail the same way XLA's fused batchnorm does.
     var = jnp.maximum(q / m - mean * mean, 0.0)
-    inv = jax.lax.rsqrt(var + eps)
-    # Apply stays XLA elementwise: it fuses with the surrounding
-    # relu/add, f32 math lives in registers, y lands back in x.dtype.
-    y = ((x.astype(jnp.float32) - mean) * (inv * gamma) + beta).astype(
-        x.dtype
-    )
+    # Apply stays XLA elementwise (_normalize): it fuses with the
+    # surrounding relu/add, f32 math lives in registers, y lands back in
+    # x.dtype.
+    y, inv = _normalize(x, mean, var, gamma, beta, eps)
     return y, mean, var, inv
 
 
@@ -208,9 +206,40 @@ def require_single_device(n_devices: int) -> None:
         )
 
 
-def batch_norm_train(x, gamma, beta, eps):
+# Layers below this many elements take the plain-XLA stats path.
+# Why a threshold exists at all: Mosaic compiles every pallas_call
+# INSTANCE separately (~1 s each, no dedup even for identical kernels —
+# measured via local chipless AOT), so ResNet-101's ~208 BN kernel
+# instances cost ~5 min of compile. The bandwidth win lives in the big
+# early-stage feature maps; restricting pallas to them keeps ~80% of
+# the win at ~25% of the compile cost. 20M elements ≈ 40 MB bf16 reads
+# per pass — stages 1-2 of ResNet-101 at batch 128 qualify.
+PALLAS_MIN_ELEMS = 20_000_000
+
+
+def _normalize(x, mean, var, gamma, beta, eps):
+    """The shared apply step both stats paths feed: f32 math, population
+    variance already clamped at 0 by the caller, output in x.dtype. One
+    definition so layers above and below the size threshold can never
+    normalize differently within one model."""
+    inv = jax.lax.rsqrt(var + eps)
+    return ((x.astype(jnp.float32) - mean) * (inv * gamma) + beta).astype(
+        x.dtype
+    ), inv
+
+
+def batch_norm_train(x, gamma, beta, eps, *,
+                     pallas_min_elems: int = PALLAS_MIN_ELEMS):
     """Fused BN plus the (stop-gradiented) batch moments for running-
-    stat updates."""
+    stat updates. Small layers (static shape check) use XLA reductions:
+    their kernels would cost more compile time than they save."""
+    if int(np.prod(x.shape)) < pallas_min_elems:
+        xf = x.astype(jnp.float32)
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
+        y, _ = _normalize(x, mean, var, gamma, beta, eps)
+        return y, jax.lax.stop_gradient(mean), jax.lax.stop_gradient(var)
     y, mean, var = fused_batch_norm(x, gamma, beta, eps)
     return y, jax.lax.stop_gradient(mean), jax.lax.stop_gradient(var)
 
@@ -227,6 +256,10 @@ class TpuBatchNorm(nn.Module):
     param_dtype: Any = jnp.float32
     scale_init: Callable = nn.initializers.ones_init()
     bias_init: Callable = nn.initializers.zeros_init()
+    # Layers smaller than this take XLA reductions (compile-time
+    # economics; see PALLAS_MIN_ELEMS). Tests set 0 to force the
+    # kernel path at any shape.
+    pallas_min_elems: int = PALLAS_MIN_ELEMS
 
     @nn.compact
     def __call__(self, x):
@@ -243,7 +276,10 @@ class TpuBatchNorm(nn.Module):
             inv = jax.lax.rsqrt(ra_var.value + self.epsilon)
             y = (x.astype(jnp.float32) - ra_mean.value) * (inv * scale) + bias
             return y.astype(self.dtype)
-        y, mean, var = batch_norm_train(x, scale, bias, self.epsilon)
+        y, mean, var = batch_norm_train(
+            x, scale, bias, self.epsilon,
+            pallas_min_elems=self.pallas_min_elems,
+        )
         # nn.BatchNorm returns self.dtype in BOTH modes; fused_batch_norm
         # returned x.dtype, which differs whenever callers don't pre-cast.
         y = y.astype(self.dtype)

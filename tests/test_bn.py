@@ -21,7 +21,10 @@ from mpi_operator_tpu.ops.bn import (
 def modules():
     kw = dict(use_running_average=False, momentum=0.9, epsilon=1e-5,
               dtype=jnp.float32, param_dtype=jnp.float32)
-    return nn.BatchNorm(**kw), TpuBatchNorm(**kw)
+    # pallas_min_elems=0: the module tests exist to pin the KERNEL path
+    # against flax; the size threshold would route these small shapes
+    # onto plain XLA and the comparison would test nothing.
+    return nn.BatchNorm(**kw), TpuBatchNorm(pallas_min_elems=0, **kw)
 
 
 def _x(m=32, h=7, w=7, c=24, dtype=jnp.float32, seed=0):
@@ -166,7 +169,7 @@ class TestTpuBatchNormModule:
         kw = dict(momentum=0.9, epsilon=1e-5, dtype=jnp.float32,
                   param_dtype=jnp.float32)
         x = _x()
-        mine = TpuBatchNorm(use_running_average=False, **kw)
+        mine = TpuBatchNorm(use_running_average=False, pallas_min_elems=0, **kw)
         v = mine.init(jax.random.PRNGKey(0), x)
         _, s = mine.apply(v, x, mutable=["batch_stats"])
         ev_mine = TpuBatchNorm(use_running_average=True, **kw)
@@ -223,3 +226,54 @@ class TestResnetWithPallasBN:
                 jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
                 train=True,
             )
+
+
+class TestSizeThresholdRouting:
+    def test_xla_fallback_branch_matches_flax(self):
+        """The sub-threshold XLA branch of batch_norm_train (what most
+        small layers run in production) must match nn.BatchNorm too —
+        forward, stats, and grads."""
+        kw = dict(use_running_average=False, momentum=0.9, epsilon=1e-5,
+                  dtype=jnp.float32, param_dtype=jnp.float32)
+        ref = nn.BatchNorm(**kw)
+        # Default threshold: the test shapes are far below 20M elements,
+        # so this instance exercises the XLA fallback path.
+        mine = TpuBatchNorm(**kw)
+        x = _x()
+        vr = ref.init(jax.random.PRNGKey(0), x)
+        vm = mine.init(jax.random.PRNGKey(0), x)
+        yr, sr = ref.apply(vr, x, mutable=["batch_stats"])
+        ym, sm = mine.apply(vm, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(ym), np.asarray(yr),
+                                   rtol=2e-5, atol=2e-5)
+        for k in ("mean", "var"):
+            np.testing.assert_allclose(
+                np.asarray(sm["batch_stats"][k]),
+                np.asarray(sr["batch_stats"][k]), rtol=1e-4, atol=1e-6,
+            )
+
+        def loss(mod, v, xx):
+            y, _ = mod.apply(v, xx, mutable=["batch_stats"])
+            return jnp.sum(y ** 2)
+
+        gr = jax.grad(lambda xx: loss(ref, vr, xx))(x)
+        gm = jax.grad(lambda xx: loss(mine, vm, xx))(x)
+        np.testing.assert_allclose(np.asarray(gm), np.asarray(gr),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_threshold_routes_statically(self):
+        # Above-threshold instances must call the pallas kernels, below
+        # must not: count pallas_call HLO custom-calls in the jaxpr.
+        from mpi_operator_tpu.ops.bn import batch_norm_train
+
+        x_small = jnp.ones((64, 4, 4, 8), jnp.float32)
+        g = jnp.ones((8,), jnp.float32)
+        b = jnp.zeros((8,), jnp.float32)
+        small = str(jax.make_jaxpr(
+            lambda x: batch_norm_train(x, g, b, 1e-5)
+        )(x_small))
+        assert "pallas" not in small
+        forced = str(jax.make_jaxpr(
+            lambda x: batch_norm_train(x, g, b, 1e-5, pallas_min_elems=0)
+        )(x_small))
+        assert "pallas" in forced
