@@ -1,0 +1,124 @@
+"""Chipless TPU AOT compilation of the pallas kernels.
+
+CPU interpret mode validates kernel NUMERICS everywhere, but Mosaic
+lowering bugs (tile-shape rules, layout constraints — e.g. round 2's
+2-D lse layout that only ran in interpret mode) surface only when the
+kernel actually compiles for TPU. The local libtpu can do that with no
+chip: `jax.experimental.topologies` builds a v5e topology description
+and `jit(...).lower(...).compile()` runs the full XLA+Mosaic pipeline.
+
+Each case runs in a subprocess: libtpu initialization needs env set
+before import and must not leak plugin state into the CPU-only test
+process.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PRELUDE = textwrap.dedent("""
+    import numpy as np
+    import jax, jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import mpi_operator_tpu.ops._common as common
+    common.use_interpret = lambda: False  # force real Mosaic lowering
+
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name="v5e:2x2x1"
+    )
+    mesh = Mesh(np.array(topo.devices[:1]).reshape(1), ("d",))
+    repl = NamedSharding(mesh, P())
+
+    def sds(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=repl)
+""")
+
+
+def _aot(body: str, timeout: int = 420) -> None:
+    env = dict(
+        os.environ,
+        PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+        TPU_ACCELERATOR_TYPE="v5litepod-1",
+        TPU_WORKER_HOSTNAMES="localhost", TPU_WORKER_ID="0",
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", _PRELUDE + textwrap.dedent(body)],
+        env=env, capture_output=True, text=True, cwd=_REPO, timeout=timeout,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "AOT_OK" in out.stdout, out.stdout[-500:]
+
+
+needs_libtpu = pytest.mark.skipif(
+    importlib.util.find_spec("libtpu") is None,
+    reason="no local libtpu for chipless AOT",
+)
+
+
+@needs_libtpu
+class TestMosaicLowering:
+    @pytest.mark.e2e
+    def test_flash_attention_fwd_bwd_compiles(self):
+        _aot("""
+            import importlib
+            import mpi_operator_tpu.ops.attention as att
+            importlib.reload(att)
+
+            q = sds((1, 4, 256, 128), jnp.bfloat16)
+
+            def loss(q, k, v):
+                return jnp.sum(
+                    att.flash_attention(q, k, v, causal=True) ** 2
+                )
+
+            jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, q, q).compile()
+            print("AOT_OK")
+        """)
+
+    @pytest.mark.e2e
+    def test_flash_gqa_and_tiles_compile(self):
+        _aot("""
+            import importlib
+            import mpi_operator_tpu.ops.attention as att
+            importlib.reload(att)
+
+            q = sds((1, 8, 512, 64), jnp.bfloat16)   # bert head_dim
+            kv = sds((1, 4, 512, 64), jnp.bfloat16)  # GQA groups=2
+
+            def loss(q, k, v):
+                return jnp.sum(att.flash_attention(
+                    q, k, v, causal=False, block_q=256, block_k=128
+                ) ** 2)
+
+            jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, kv, kv).compile()
+            print("AOT_OK")
+        """)
+
+    @pytest.mark.e2e
+    def test_bn_kernels_compile(self):
+        _aot("""
+            import importlib
+            import mpi_operator_tpu.ops.bn as bn
+            importlib.reload(bn)
+
+            x = sds((128 * 56 * 56, 64), jnp.bfloat16)
+            jax.jit(bn.bn_stats).lower(x).compile()
+
+            x4 = sds((32, 56, 56, 256), jnp.bfloat16)
+            g = sds((256,), jnp.float32)
+
+            def loss(x, g, b):
+                y, m, v = bn.fused_batch_norm(x, g, b, 1e-5)
+                return jnp.sum(y.astype(jnp.float32))
+
+            jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(x4, g, g).compile()
+            print("AOT_OK")
+        """)
