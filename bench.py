@@ -684,6 +684,76 @@ SUITES = {
 }
 
 
+_PROBE_CHILD = """
+import os, sys, threading, time
+t0 = time.time()
+def _dead():
+    print(f"PROBE_TIMEOUT after {{time.time()-t0:.0f}}s", flush=True)
+    os._exit(3)
+timer = threading.Timer({timeout:.0f}, _dead)
+timer.daemon = True
+timer.start()
+import numpy as np
+import jax, jax.numpy as jnp
+devs = jax.devices()
+if devs[0].platform == "cpu":
+    # Silent CPU fallback must NOT count as "TPU ready" — a capture on
+    # CPU would be recorded as hardware numbers.
+    print(f"PROBE_WRONG_PLATFORM {{devs}}", flush=True)
+    sys.exit(4)
+x = jnp.ones((256, 256), jnp.bfloat16)
+np.asarray(x @ x)  # readback barrier: device really ran
+print(f"PROBE_OK {{devs[0].device_kind}} t={{time.time()-t0:.1f}}s", flush=True)
+sys.exit(0)
+"""
+
+
+def _probe_tpu_ready(budget_s: float, probe_timeout_s: float = 150.0) -> bool:
+    """Wait for the accelerator tunnel to answer, via naturally-exiting
+    subprocess probes with backoff.
+
+    Backend init in THIS process is one-shot: once ``jax.devices()``
+    blocks on a wedged tunnel, the process can only abort (rc=3, see
+    ``_backend_watchdog``) — which is exactly what produced two rounds
+    of dead driver artifacts when the tunnel woke slowly. So before
+    committing the main process, spawn a tiny matmul probe as a CHILD
+    with its own in-process deadman (``os._exit`` — the child exits by
+    itself; nothing external kills a client mid-TPU-work, which can
+    wedge the remote runtime). Retry until ``budget_s`` is spent."""
+    import subprocess
+
+    deadline = time.time() + budget_s
+    code = _PROBE_CHILD.format(timeout=probe_timeout_s)
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                timeout=probe_timeout_s + 60,  # failsafe; child self-exits
+                capture_output=True, text=True,
+            )
+            rc, out = proc.returncode, proc.stdout + proc.stderr
+        except subprocess.TimeoutExpired:
+            rc, out = -1, "(failsafe timeout: child never self-exited)"
+        if rc == 0:
+            log(f"TPU probe ok (attempt {attempt}): "
+                f"{out.strip().splitlines()[-1]}")
+            return True
+        # A deterministic failure (import error, auth) looks identical
+        # to a wedged tunnel by rc alone — log the child's last lines.
+        tail = " | ".join(out.strip().splitlines()[-3:]) or "(no output)"
+        log(f"TPU probe attempt {attempt}: rc={rc}: {tail[:300]}")
+        remaining = deadline - time.time()
+        if remaining <= 0:
+            log(f"TPU probe gave up after {attempt} attempts / "
+                f"{budget_s:.0f}s budget (last rc={rc})")
+            return False
+        wait = min(45.0, remaining)
+        log(f"retrying in {wait:.0f}s ({remaining:.0f}s left in budget)")
+        time.sleep(wait)
+
+
 def _backend_watchdog(timeout_s: float):
     """The TPU tunnel in this environment can wedge so hard that backend
     init blocks forever (no exception, no timeout). Arm a deadman: if
@@ -767,6 +837,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--scale-jobs", type=int, default=200,
                         help="operator-scale suite: size of the TPUJob "
                              "creation storm")
+    parser.add_argument("--probe-only", action="store_true",
+                        help="probe the accelerator (child process with "
+                             "deadman, BENCH_PROBE_BUDGET_S retry budget) "
+                             "and exit 0/3 — the single shared probe "
+                             "hack/tpu_bench_all.sh uses")
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument("--profile-dir", default="")
@@ -778,16 +853,36 @@ def build_parser() -> argparse.ArgumentParser:
 def main() -> int:
     args = build_parser().parse_args()
 
+    try:
+        timeout_s = float(os.environ.get("BENCH_BACKEND_TIMEOUT_S", "180"))
+        probe_budget_s = float(os.environ.get("BENCH_PROBE_BUDGET_S", "600"))
+    except ValueError:
+        raise SystemExit(
+            "BENCH_BACKEND_TIMEOUT_S / BENCH_PROBE_BUDGET_S must be "
+            "numbers of seconds"
+        )
+    # Primary platform = first entry of JAX_PLATFORMS (empty = default,
+    # i.e. the accelerator): 'tpu,cpu' still means a TPU run and must
+    # still probe; only a CPU-primary run skips.
+    primary_platform = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+
+    if args.probe_only:
+        return 0 if _probe_tpu_ready(max(probe_budget_s, 1.0)) else 3
+
     # Fail fast if the accelerator tunnel is wedged. Env override
     # BENCH_BACKEND_TIMEOUT_S (seconds; <= 0 disables the watchdog);
     # the startup suite is CPU-only and skips it.
     if args.suite not in ("startup", "operator-scale"):  # CPU-only suites
-        try:
-            timeout_s = float(os.environ.get("BENCH_BACKEND_TIMEOUT_S", "180"))
-        except ValueError:
-            raise SystemExit(
-                "BENCH_BACKEND_TIMEOUT_S must be a number of seconds"
-            )
+        # A slow-waking tunnel is the common failure (two rounds of rc=3
+        # driver artifacts): probe-retry in child processes FIRST, so the
+        # one-shot in-process init below only starts once the chip
+        # answers. BENCH_PROBE_BUDGET_S=0 skips (hack/tpu_bench_all.sh
+        # sets it — it already probed). CPU runs never probe.
+        if probe_budget_s > 0 and primary_platform != "cpu":
+            if not _probe_tpu_ready(probe_budget_s):
+                log("FATAL: accelerator tunnel never answered a probe; "
+                    "aborting before backend init")
+                return 3
         if timeout_s > 0:
             ready = _backend_watchdog(timeout_s)
             import jax

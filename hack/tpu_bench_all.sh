@@ -13,21 +13,12 @@
 set -u
 cd "$(dirname "$0")/.."
 
+# One shared probe implementation (bench.py --probe-only): child process
+# with a deadman that self-exits — a wedged tunnel blocks init forever,
+# and externally killing a TPU client can wedge the remote runtime.
+# Budget 1s = a single attempt here; callers wanting retry set it higher.
 probe() {
-  python - <<'EOF'
-import sys, time
-import numpy as np
-t0 = time.time()
-import jax, jax.numpy as jnp
-try:
-    jax.devices()
-except Exception as e:
-    print(f"PROBE_FAIL init: {e!r}")
-    sys.exit(2)
-x = jnp.ones((512, 512), jnp.bfloat16)
-val = float(np.asarray(x @ x)[0, 0])
-print(f"PROBE_OK readback={val} init+run={time.time()-t0:.1f}s")
-EOF
+  BENCH_PROBE_BUDGET_S="${BENCH_PROBE_BUDGET_S:-1}" python bench.py --probe-only
 }
 
 echo "== probing the TPU =="
@@ -45,8 +36,9 @@ run() {
   label="$1"; shift
   echo "== $label =="
   log=$(mktemp)
-  # NO timeout wrapper — see the header.
-  python bench.py "$@" 2>&1 | tee "$log"
+  # NO timeout wrapper — see the header. The probe above already ran, so
+  # skip bench.py's own probe-retry loop (~20 s of extra init per suite).
+  BENCH_PROBE_BUDGET_S=0 python bench.py "$@" 2>&1 | tee "$log"
   line=$(grep -E '^\{' "$log" | tail -1)
   if [ -n "$line" ]; then
     echo "{\"label\": \"$label\", \"stamp\": \"$stamp\", \"result\": $line}" >> "$out"
