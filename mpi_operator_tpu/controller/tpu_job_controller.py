@@ -409,6 +409,11 @@ class TPUJobController:
                     launcher = self._read_through_adopt(
                         self.kube.jobs(namespace), job,
                         builders.launcher_name(job),
+                        recreate=lambda: self.kube.jobs(namespace).create(
+                            builders.new_launcher_job(
+                                job, self.gang_scheduler_name
+                            )
+                        ).to_dict(),
                     )
                 except Exception as e:
                     self.recorder.eventf(
@@ -433,12 +438,25 @@ class TPUJobController:
         )
         self.recorder.event(job, EVENT_TYPE_WARNING, ERR_RESOURCE_EXISTS_REASON, msg)
 
-    def _read_through_adopt(self, client, job: TPUJob, name: str) -> dict:
+    def _read_through_adopt(self, client, job: TPUJob, name: str,
+                            recreate=None) -> dict:
         """After a create hit AlreadyExists because the informer cache
         lags the apiserver: fetch the live object and enforce the same
         adoption check every cached path applies. One place for the
-        read-through discipline all five create sites share."""
-        existing = client.get(name).to_dict()
+        read-through discipline all five create sites share.
+
+        ``recreate``: a zero-arg create retry. A foreign delete can race
+        the window between the AlreadyExists and this get — without the
+        retry that NotFound would fail the sync into a backoff requeue,
+        the exact cost the read-through exists to avoid. A second
+        AlreadyExists inside the retry means a same-named foreign writer
+        is actively churning — that one IS left to the requeue path."""
+        try:
+            existing = client.get(name).to_dict()
+        except NotFoundError:
+            if recreate is None:
+                raise
+            return recreate()
         if not is_controlled_by(existing, job):
             self._flag_not_controlled(job, existing)
             raise RuntimeError(
@@ -474,7 +492,9 @@ class TPUJobController:
                 return self.kube.services(job.namespace).create(desired).to_dict()
             except AlreadyExistsError:
                 existing = self._read_through_adopt(
-                    self.kube.services(job.namespace), job, desired.name
+                    self.kube.services(job.namespace), job, desired.name,
+                    recreate=lambda: self.kube.services(job.namespace)
+                    .create(desired).to_dict(),
                 )
         if not is_controlled_by(existing, job):
             self._flag_not_controlled(job, existing)
@@ -498,7 +518,9 @@ class TPUJobController:
                 return self.kube.configmaps(job.namespace).create(desired).to_dict()
             except AlreadyExistsError:  # stale cache; see _get_or_create_service
                 existing = self._read_through_adopt(
-                    self.kube.configmaps(job.namespace), job, desired.name
+                    self.kube.configmaps(job.namespace), job, desired.name,
+                    recreate=lambda: self.kube.configmaps(job.namespace)
+                    .create(desired).to_dict(),
                 )
         if not is_controlled_by(existing, job):
             self._flag_not_controlled(job, existing)
@@ -516,7 +538,9 @@ class TPUJobController:
                 # foreign recreate — the adoption check must run again
                 # before writing over it.
                 fresh = self._read_through_adopt(
-                    self.kube.configmaps(job.namespace), job, desired.name
+                    self.kube.configmaps(job.namespace), job, desired.name,
+                    recreate=lambda: self.kube.configmaps(job.namespace)
+                    .create(desired).to_dict(),
                 )
                 if fresh.get("data") == desired.data:
                     return fresh
@@ -537,7 +561,10 @@ class TPUJobController:
                 )
             except AlreadyExistsError:  # stale cache; see _get_or_create_service
                 existing = self._read_through_adopt(
-                    self.scheduling.podgroups(job.namespace), job, job.name
+                    self.scheduling.podgroups(job.namespace), job, job.name,
+                    recreate=lambda: self.scheduling.podgroups(job.namespace)
+                    .create(builders.new_pod_group(job, min_member))
+                    .to_dict(),
                 )
         if not is_controlled_by(existing, job):
             self._flag_not_controlled(job, existing)
@@ -667,7 +694,12 @@ class TPUJobController:
                     # a stale-world-size or failed pod must not survive
                     # adoption for a sync period.
                     pod = self._read_through_adopt(
-                        self.kube.pods(job.namespace), job, name
+                        self.kube.pods(job.namespace), job, name,
+                        recreate=lambda i=i: self.kube.pods(job.namespace)
+                        .create(builders.new_worker(
+                            job, i, self.gang_scheduler_name
+                        ))
+                        .to_dict(),
                     )
                     reason = self._elastic_restart_reason(
                         job, pod, replicas,

@@ -404,6 +404,36 @@ class TestAdoptionConflicts:
             f.controller.sync_handler("default/test-job")
 
 
+class TestReadThroughDeleteRace:
+    def test_foreign_delete_between_conflict_and_get_recreates(self):
+        """AlreadyExists at create, then NotFound at the read-through
+        (the foreign same-named object was deleted in the race window):
+        the sync must retry the create once and succeed, not fail into
+        a backoff requeue (ADVICE round 3)."""
+        from mpi_operator_tpu.runtime.apiserver import AlreadyExistsError
+
+        f = Fixture()
+        f.start()
+        real_create = f.api.create
+        fired = []
+
+        def create_conflict_once(resource, obj, **kw):
+            if resource == "services" and not fired:
+                # Simulate: a foreign service existed at create time...
+                fired.append(True)
+                raise AlreadyExistsError(resource, obj["metadata"]["name"])
+            # ...and was gone by the read-through get (delete race).
+            return real_create(resource, obj, **kw)
+
+        f.api.create = create_conflict_once
+        f.create_job(f.new_job())
+        f.controller.factory.pump_until_quiet()
+        f.controller.sync_handler("default/test-job")  # must not raise
+        assert fired
+        svc = f.api.get("services", "default", "test-job-worker")
+        assert svc is not None
+
+
 class TestValidationRejected:
     def test_invalid_job_emits_event_not_requeued(self):
         f = Fixture()

@@ -124,6 +124,38 @@ class TestMoEOracle:
             assert float(jnp.max(jnp.abs(grads[name]))) > 0.0, name
 
 
+class TestCombineDtype:
+    def test_router_grad_parity_bf16_combine(self):
+        """The combine weights are cast to the compute dtype (bf16)
+        before the output einsum. The router's learning signal must not
+        be biased by that cast: d(combine) in the bilinear einsum never
+        reads the combine VALUES, so router grads with bf16-cast vs f32
+        combine agree to bf16 rounding order (ADVICE round 3)."""
+        d, f, e = 16, 32, 4
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(2, 16, d), jnp.bfloat16
+        )
+        kwargs = dict(
+            dim=d, ffn_dim=f, n_experts=e, top_k=2, capacity_factor=2.0,
+            dtype=jnp.bfloat16,
+        )
+        m_bf16 = MoEMLP(**kwargs)
+        m_f32 = MoEMLP(**kwargs, combine_dtype=jnp.float32)
+        params = m_bf16.init(jax.random.PRNGKey(0), x)["params"]
+
+        def router_grad(model):
+            def loss(p):
+                out, aux = model.apply({"params": p}, x)
+                return jnp.sum(out.astype(jnp.float32) ** 2) + 0.01 * aux
+
+            return jax.grad(loss)(params)["router"]
+
+        g_bf16, g_f32 = router_grad(m_bf16), router_grad(m_f32)
+        scale = float(jnp.max(jnp.abs(g_f32))) or 1.0
+        rel = float(jnp.max(jnp.abs(g_bf16 - g_f32))) / scale
+        assert rel < 2e-2, f"router grads diverge: rel={rel:.3e}"
+
+
 class TestLlamaMoE:
     def test_tiny_moe_loss_decreases(self):
         cfg = llama_lib.tiny_moe()
