@@ -53,9 +53,15 @@ run bert-base          --suite bert --profile-dir /tmp/trace-bert
 run llama-0p7b         --suite llama --profile-dir /tmp/trace-llama
 run startup            --suite startup
 # Kernel-vs-compiler A/Bs (each isolates one hypothesis from the
-# round-3 MFU gap analysis; see docs/round3-notes.md).
+# round-3 MFU gap analysis; see docs/round3-notes.md). The suites above
+# already run the flat [B,S,H·D] kernels (the round-4 default); the
+# bhsd lines time the old transpose-convention layout against them.
+run bert-flash-bhsd    --suite bert --attention-impl flash-bhsd
+run llama-flash-bhsd   --suite llama --attention-impl flash-bhsd
 run bert-dense-attn    --suite bert --attention-impl dense
 run llama-dense-attn   --suite llama --attention-impl dense
+# Batch-8 via bf16 adam first moment (no extra FLOPs; fits 16G).
+run llama-b8-mu-bf16   --suite llama --llama-batch 8 --adam-mu-dtype bf16
 # ResNet A/Bs: scanned stages (compile-friendly form) and pallas BN.
 # Chipless-AOT analysis (docs/round3-notes.md) localized round 3's
 # 29-min "hang" to the eager-init kernel storm (fixed: init is jitted)

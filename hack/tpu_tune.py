@@ -44,6 +44,12 @@ def ns(**kw) -> argparse.Namespace:
 LLAMA_SWEEP = [
     # name, overrides — ordered so the most informative A/Bs come first.
     ("base-b4-dots-fb128", {}),
+    # Batch-8 unlock with NO extra FLOPs: bf16 adam first moment frees
+    # 1.48 GB, vs full-remat-b8's +33% recompute (both points stay).
+    ("b8-dots-mu-bf16", {"llama_batch": 8, "adam_mu_dtype": "bf16"}),
+    # Kernel-layout A/B: flat [B,S,H·D] (default) vs the transpose
+    # convention — isolates the layout-copy elimination.
+    ("flash-bhsd", {"attention_impl": "flash-bhsd"}),
     ("dense-attn", {"attention_impl": "dense"}),
     ("fb256", {"flash_block_q": 256, "flash_block_k": 256}),
     ("fb512", {"flash_block_q": 512, "flash_block_k": 512}),
@@ -58,6 +64,7 @@ LLAMA_SWEEP = [
 
 BERT_SWEEP = [
     ("base-b64-fb128", {"suite": "bert"}),
+    ("flash-bhsd", {"suite": "bert", "attention_impl": "flash-bhsd"}),
     ("dense-attn", {"suite": "bert", "attention_impl": "dense"}),
     ("fb256", {"suite": "bert", "flash_block_q": 256, "flash_block_k": 256}),
     ("fb512", {"suite": "bert", "flash_block_q": 512, "flash_block_k": 512}),
