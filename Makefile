@@ -8,6 +8,7 @@
 PYTHON ?= python3
 
 .PHONY: all native manifests verify-manifests lint \
+        test-kernel test-operator \
         test test-unit test-integration test-e2e ci clean
 
 all: native manifests
@@ -35,9 +36,16 @@ lint: verify-manifests
 # Test tiers (SURVEY.md §4): unit, integration (in-memory apiserver +
 # envtest-style HTTP kube backend), e2e (real subprocess workers doing
 # jax.distributed over localhost). conftest.py pins the 8-device virtual
-# CPU mesh for all of them.
+# CPU mesh for all of them and auto-marks every test 'kernel' or
+# 'operator' (select with -m). pytest-xdist parallelizes when the box
+# has cores to spare (this sandbox exposes 1 CPU — xdist is a no-op
+# here but halves wall-clock on multi-core CI).
+NPROC := $(shell nproc 2>/dev/null || echo 1)
+XDIST := $(shell [ $(NPROC) -gt 1 ] && $(PYTHON) -c 'import xdist' \
+    2>/dev/null && echo "-n auto")
+
 test-unit:
-	$(PYTHON) -m pytest tests -q -m "not e2e" \
+	$(PYTHON) -m pytest tests -q -m "not e2e" $(XDIST) \
 	    --ignore=tests/test_integration.py --ignore=tests/test_kube_backend.py
 
 test-integration:
@@ -46,8 +54,14 @@ test-integration:
 test-e2e:
 	$(PYTHON) -m pytest tests -q -m e2e
 
+test-kernel:
+	$(PYTHON) -m pytest tests -q -m kernel $(XDIST)
+
+test-operator:
+	$(PYTHON) -m pytest tests -q -m operator $(XDIST)
+
 test:
-	$(PYTHON) -m pytest tests -q
+	$(PYTHON) -m pytest tests -q $(XDIST)
 
 ci: lint native test
 

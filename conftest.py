@@ -24,3 +24,28 @@ assert jax.devices()[0].platform == "cpu", (
     f"{jax.devices()[0].platform!r}"
 )
 assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
+
+
+# ---------------------------------------------------------------------------
+# Tier auto-marking (reference analog: Makefile:54-72 test tiers). Every
+# test gets a tier marker derived from its file so `-m kernel` /
+# `-m operator` select tiers without per-file pytestmark boilerplate;
+# `e2e` stays an explicit per-test marker (it cuts across both tiers).
+# ---------------------------------------------------------------------------
+
+_KERNEL_TIER = {
+    # ML compute: kernels, models, parallelism, training CLIs, bench.
+    "test_ops", "test_bn", "test_ulysses", "test_losses", "test_accum",
+    "test_parallel", "test_pipeline", "test_models", "test_transformers",
+    "test_moe", "test_llama_pp", "test_data", "test_train", "test_eval",
+    "test_generate", "test_tune", "test_bench", "test_tpu_aot",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    for item in items:
+        name = item.fspath.purebasename
+        tier = "kernel" if name in _KERNEL_TIER else "operator"
+        item.add_marker(getattr(pytest.mark, tier))
