@@ -1,0 +1,209 @@
+#!/usr/bin/env python3
+"""Chipless HLO traffic census of the bench train steps.
+
+Compiles the exact bench.py-shaped train programs for a v5e (via
+``jax.experimental.topologies`` — no chip needed), then reports:
+
+- XLA cost-model FLOPs / bytes accessed and the MXU/HBM roofline floors
+  (v5e: 197 bf16 TFLOP/s, 819 GB/s), and
+- a census of pure data-movement ops (copy / copy-start / copy-done /
+  transpose / bitcast-convert) by output bytes — the instrument that
+  localized round 3's 12.5 GB/step of layout copies around the
+  [B, H, S, D]-convention attention calls (PERF.md), and the receipt
+  that the [B, S, H·D]-flat kernels remove them.
+
+Usage:
+    python hack/hlo_traffic.py bert  [--attention-impl flash|flash-bhsd|dense]
+    python hack/hlo_traffic.py llama [--attention-impl ...]
+
+Runs fully locally (JAX_PLATFORMS=cpu + local libtpu AOT); safe while
+the TPU tunnel is down. ~1-4 min per program.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+import time
+
+# This tool is chipless BY DESIGN, but the image's sitecustomize
+# registers the axon TPU plugin at interpreter startup when
+# PALLAS_AXON_POOL_IPS is set — before any code here runs, and a down
+# tunnel then wedges backend init. Re-exec with a scrubbed env (the
+# same discipline as __graft_entry__.dryrun_multichip's subprocess).
+if os.environ.get("PALLAS_AXON_POOL_IPS") or os.environ.get(
+    "JAX_PLATFORMS", "cpu"
+) != "cpu":
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    for var in ("TPU_LIBRARY_PATH", "PJRT_DEVICE", "TPU_NAME"):
+        env.pop(var, None)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-1")
+os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+os.environ.setdefault("TPU_WORKER_ID", "0")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+V5E_PEAK_TF = 197.0
+V5E_HBM_GBS = 819.0
+
+_DTYPE_BYTES = {
+    "bf16": 2, "f16": 2, "f32": 4, "f64": 8, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "s32": 4, "u32": 4, "s64": 8, "u64": 8,
+    "pred": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+}
+
+# `%name = bf16[64,512,768]{2,1,0:...} copy(...)` — capture dtype, dims, op.
+_INSTR = re.compile(
+    r"=\s+(\w+)\[([\d,]*)\][^ ]*\s+([\w-]+)\("
+)
+
+_MOVEMENT_OPS = ("copy", "copy-start", "copy-done", "transpose")
+
+
+def _census(hlo_text: str):
+    """{op kind: (count, output bytes)} for data-movement ops, plus the
+    largest movement instructions for naming the culprits."""
+    totals: dict[str, list[float]] = {}
+    biggest: list[tuple[float, str]] = []
+    for m in _INSTR.finditer(hlo_text):
+        dtype, dims, op = m.groups()
+        if op not in _MOVEMENT_OPS:
+            continue
+        size = _DTYPE_BYTES.get(dtype, 4)
+        for dim in dims.split(","):
+            if dim:
+                size *= int(dim)
+        cnt, tot = totals.setdefault(op, [0, 0.0])
+        totals[op] = [cnt + 1, tot + size]
+        line = hlo_text[m.start():m.end() + 60].split("\n")[0]
+        biggest.append((size, f"{dtype}[{dims}] {line[-60:]}"))
+    biggest.sort(reverse=True)
+    return totals, biggest[:8]
+
+
+def _build(suite: str, attention_impl: str, mesh):
+    """The bench.py-shaped train step + abstract args for one suite
+    (same configs as bench.bench_bert / bench.bench_llama)."""
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+
+    def sds(x):
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=repl), x
+        )
+
+    if suite == "bert":
+        from mpi_operator_tpu.models import bert as bert_lib
+
+        cfg = bert_lib.bert_base(attention_impl=attention_impl)
+        model = bert_lib.Bert(cfg)
+        batch, seq = 64, 512
+        params = jax.eval_shape(
+            lambda: bert_lib.init_params(
+                model, jax.random.PRNGKey(0), batch=2, seq=seq
+            )
+        )
+        optimizer = optax.adamw(1e-4)
+        opt_state = jax.eval_shape(optimizer.init, params)
+        n_pred = int(seq * 0.15)
+        step = bert_lib.make_train_step_positions(model, optimizer)
+        args = (
+            params, opt_state,
+            jax.ShapeDtypeStruct((batch, seq), np.int32, sharding=repl),
+            jax.ShapeDtypeStruct((batch, n_pred), np.int32, sharding=repl),
+            jax.ShapeDtypeStruct((batch, n_pred), np.int32, sharding=repl),
+            jax.ShapeDtypeStruct((batch, n_pred), np.float32, sharding=repl),
+        )
+        return step, tuple(sds(a) if not isinstance(a, jax.ShapeDtypeStruct)
+                           else a for a in args)
+
+    if suite == "llama":
+        from mpi_operator_tpu.models import llama as llama_lib
+
+        cfg = llama_lib.llama3_8b(
+            vocab_size=32768, dim=2048, n_layers=12, n_heads=16,
+            n_kv_heads=8, ffn_dim=6144, max_seq_len=2048,
+            remat_policy="dots", xent_chunk=512,
+            attention_impl=attention_impl,
+        )
+        model = llama_lib.Llama(cfg)
+        batch, seq = 4, 2048
+        params = jax.eval_shape(
+            lambda: llama_lib.init_params(
+                model, jax.random.PRNGKey(0), batch=1, seq=seq
+            )
+        )
+        optimizer = optax.adamw(3e-4)
+        opt_state = jax.eval_shape(optimizer.init, params)
+        step = llama_lib.make_train_step(model, optimizer)
+        args = (
+            params, opt_state,
+            jax.ShapeDtypeStruct((batch, seq), np.int32, sharding=repl),
+        )
+        return step, tuple(sds(a) if not isinstance(a, jax.ShapeDtypeStruct)
+                           else a for a in args)
+
+    raise SystemExit(f"unknown suite {suite!r}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("suite", choices=["bert", "llama"])
+    ap.add_argument("--attention-impl", default="flash",
+                    choices=["flash", "flash-bhsd", "dense"])
+    args = ap.parse_args()
+
+    import numpy as np
+    import jax
+    from jax.experimental import topologies
+    from jax.sharding import Mesh
+
+    import mpi_operator_tpu.ops._common as common
+    common.use_interpret = lambda: False  # real Mosaic lowering
+
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name="v5e:2x2x1"
+    )
+    mesh = Mesh(np.array(topo.devices[:1]).reshape(1), ("d",))
+
+    step, abstract_args = _build(args.suite, args.attention_impl, mesh)
+    print(f"compiling {args.suite} (attention={args.attention_impl}) "
+          f"for v5e...", flush=True)
+    t0 = time.time()
+    compiled = jax.jit(step, donate_argnums=(0, 1)).lower(
+        *abstract_args
+    ).compile()
+    print(f"compiled in {time.time() - t0:.0f}s")
+
+    ca = compiled.cost_analysis() or {}
+    flops, byts = ca.get("flops", 0.0), ca.get("bytes accessed", 0.0)
+    if flops:
+        mxu_ms = flops / (V5E_PEAK_TF * 1e9)
+        hbm_ms = byts / (V5E_HBM_GBS * 1e6)
+        print(f"cost model: {flops / 1e12:.1f} TF, {byts / 1e9:.1f} GB -> "
+              f"MXU floor {mxu_ms:.0f} ms, HBM floor {hbm_ms:.0f} ms "
+              f"(pallas custom-call internals NOT counted)")
+
+    totals, biggest = _census(compiled.as_text())
+    grand = sum(t for _, t in totals.values())
+    print(f"data-movement census: {grand / 1e9:.2f} GB total")
+    for op, (cnt, tot) in sorted(totals.items(), key=lambda kv: -kv[1][1]):
+        print(f"  {op:12s} x{cnt:<5d} {tot / 1e9:7.2f} GB")
+    if biggest:
+        print("largest movement instructions:")
+        for size, desc in biggest:
+            print(f"  {size / 1e6:8.1f} MB  {desc}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
