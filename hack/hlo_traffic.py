@@ -80,10 +80,14 @@ def _census(hlo_text: str):
                 size *= int(dim)
         cnt, tot = totals.setdefault(op, [0, 0.0])
         totals[op] = [cnt + 1, tot + size]
-        line = hlo_text[m.start():m.end() + 60].split("\n")[0]
-        biggest.append((size, f"{dtype}[{dims}] {line[-60:]}"))
-    biggest.sort(reverse=True)
-    return totals, biggest[:8]
+        window = hlo_text[m.start():m.start() + 600].split("\n")[0]
+        name = re.search(r'op_name="([^"]*)"', window)
+        biggest.append((
+            size,
+            f"{op} {dtype}[{dims}] {name.group(1)[-90:] if name else '?'}",
+        ))
+    biggest.sort(key=lambda t: -t[0])
+    return totals, biggest[:10]
 
 
 def _build(suite: str, attention_impl: str, mesh):
@@ -160,6 +164,9 @@ def main() -> int:
     ap.add_argument("suite", choices=["bert", "llama"])
     ap.add_argument("--attention-impl", default="flash",
                     choices=["flash", "flash-bhsd", "dense"])
+    ap.add_argument("--dump", default="",
+                    help="write the compiled HLO text here for manual "
+                         "inspection (hundreds of MB for the big suites)")
     args = ap.parse_args()
 
     import numpy as np
@@ -193,7 +200,12 @@ def main() -> int:
               f"MXU floor {mxu_ms:.0f} ms, HBM floor {hbm_ms:.0f} ms "
               f"(pallas custom-call internals NOT counted)")
 
-    totals, biggest = _census(compiled.as_text())
+    hlo_text = compiled.as_text()
+    if args.dump:
+        with open(args.dump, "w") as f:
+            f.write(hlo_text)
+        print(f"HLO dumped to {args.dump} ({len(hlo_text) / 1e6:.0f} MB)")
+    totals, biggest = _census(hlo_text)
     grand = sum(t for _, t in totals.values())
     print(f"data-movement census: {grand / 1e9:.2f} GB total")
     for op, (cnt, tot) in sorted(totals.items(), key=lambda kv: -kv[1][1]):

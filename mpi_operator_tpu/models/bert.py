@@ -72,16 +72,30 @@ class EncoderLayer(nn.Module):
             feats, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
         )
 
-        q = dense(cfg.dim, "wq")(x).reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
-        k = dense(cfg.dim, "wk")(x).reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
-        v = dense(cfg.dim, "wv")(x).reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
-        from ..ops.ring_attention import sp_attention
+        q = dense(cfg.dim, "wq")(x).reshape(b, s, cfg.n_heads, hd)
+        k = dense(cfg.dim, "wk")(x).reshape(b, s, cfg.n_heads, hd)
+        v = dense(cfg.dim, "wv")(x).reshape(b, s, cfg.n_heads, hd)
+        if cfg.attention_impl == "flash":
+            # Projection-layout kernel ([B, S, H, D] straight from the
+            # Dense reshape): zero layout copies around the attention
+            # custom calls (see ops/attention.py:flash_attention_bshd).
+            from ..ops.attention import flash_attention_bshd
 
-        att = sp_attention(
-            q, k, v, self.mesh, cfg.attention_impl, causal=False,
-            block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
-        )
-        att = att.transpose(0, 2, 1, 3).reshape(b, s, cfg.dim)
+            att = flash_attention_bshd(
+                q, k, v, causal=False,
+                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+            ).reshape(b, s, cfg.dim)
+        else:
+            # [B, H, S, D] convention (flash-bhsd A/B, dense oracle,
+            # and the sequence-parallel strategies).
+            from ..ops.ring_attention import sp_attention
+
+            q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+            att = sp_attention(
+                q, k, v, self.mesh, cfg.attention_impl, causal=False,
+                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+            )
+            att = att.transpose(0, 2, 1, 3).reshape(b, s, cfg.dim)
         x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="attn_norm")(
             x + dense(cfg.dim, "wo")(att)
         )

@@ -232,9 +232,24 @@ class Attention(nn.Module):
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
 
-        # [B, H, S, D] layout. flash/ring/ulysses take GQA-shaped kv
-        # natively; the shared dispatch expands kv only for the dense
-        # oracle. Unknown impl names raise there.
+        if cfg.attention_impl == "flash":
+            # Projection-layout kernel: q/k/v go in exactly as RoPE
+            # produced them ([B, S, H, D]) — the [B, H, S, D] convention
+            # forces XLA to materialize layout copies around the kernel
+            # on all four tensors, fwd and bwd, every layer (PERF.md:
+            # 12.5 GB/step on the BERT program).
+            from ..ops.attention import flash_attention_bshd
+
+            out = flash_attention_bshd(
+                q, k, v, causal=True,
+                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+            ).reshape(b, s, cfg.n_heads * hd)
+            return dense(cfg.dim, "wo")(out)
+
+        # [B, H, S, D] layout. flash-bhsd (the transpose-convention
+        # kernel, kept as the hardware A/B) /ring/ulysses take
+        # GQA-shaped kv natively; the shared dispatch expands kv only
+        # for the dense oracle. Unknown impl names raise there.
         from ..ops.ring_attention import sp_attention
 
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))

@@ -251,7 +251,9 @@ def make_pp_loss_fn(cfg: LlamaConfig, mesh, microbatch_size: int):
     """Next-token CE with the blocks pipelined over pp. Params must be in
     the ``pp_params_from_init`` layout. Honors ``cfg.xent_chunk`` and
     ``cfg.remat`` (each layer inside a stage is checkpointed)."""
-    if cfg.attention_impl not in ("flash", "dense", "ring", "ulysses"):
+    if cfg.attention_impl not in (
+        "flash", "flash-bhsd", "dense", "ring", "ulysses"
+    ):
         raise ValueError(
             f"pipelined Llama runs flash/dense attention inside stages "
             f"(or the ppermute ring / Ulysses all-to-alls when the mesh "

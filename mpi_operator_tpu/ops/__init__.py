@@ -9,7 +9,12 @@ zigzag layout for causal balance) and Ulysses all-to-all (head-sharded
 full-sequence flash between two ICI all-to-alls).
 """
 
-from .attention import attention_reference, flash_attention, flash_attention_lse
+from .attention import (
+    attention_reference,
+    flash_attention,
+    flash_attention_bshd,
+    flash_attention_lse,
+)
 from .ring_attention import (
     ring_attention,
     ring_attention_sharded,
@@ -22,6 +27,7 @@ from .ulysses import ulysses_attention, ulysses_attention_sharded
 __all__ = [
     "attention_reference",
     "flash_attention",
+    "flash_attention_bshd",
     "flash_attention_lse",
     "lm_xent_chunked",
     "ring_attention",

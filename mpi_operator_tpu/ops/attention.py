@@ -591,6 +591,438 @@ def _flash_lse_bwd(sm_scale, causal, block_q, block_k, interpret, res, cts):
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+# ---------------------------------------------------------------------------
+# [B, S, H·D]-flat kernels (zero-layout-change path)
+# ---------------------------------------------------------------------------
+#
+# The [B, H, S, D] kernels above force the host program into
+#   Dense → reshape → transpose(0,2,1,3) → kernel → transpose back
+# and XLA materializes those transposes as pure copies around every
+# attention custom call — measured at 12.5 GB/step on the BERT bench
+# program (PERF.md round-3 HLO accounting), the single largest named
+# loss behind the transformer MFU gap. These kernels instead take the
+# RAW projection layout: operands [B, S, H·D] (exactly what nn.Dense —
+# and RoPE over [B, S, H, D], a free reshape away — produce), blocks
+# (1, block_q, H·D), and a STATIC per-head loop inside the kernel
+# slicing contiguous [:, h·d:(h+1)·d] lane tiles. A 4-D [B, S, H, D]
+# kernel blocking H to 1 is not expressible (Mosaic requires the
+# trailing two block dims (8, 128)-divisible or full), which is why the
+# head loop lives inside the kernel body. lse/delta ride as [B, S, H]
+# (trailing block dims (block_q, H-full) — legal), which also makes the
+# backward's delta = rowsum(do·o) a transpose-free reduction.
+
+
+def _fwd_flat_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, sm_scale, causal, q_len, kv_len, block_q, block_k, h, d, groups,
+):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    mask, live = _block_mask(
+        i, j, None, None,
+        causal=causal, q_len=q_len, kv_len=kv_len,
+        block_q=block_q, block_k=block_k,
+    )
+
+    def compute():
+        for hh in range(h):
+            hk = hh // groups
+            s = jax.lax.dot_general(
+                q_ref[0][:, hh * d:(hh + 1) * d],
+                k_ref[0][:, hk * d:(hk + 1) * d],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale
+            # Per-head running stats live in LANE hh of one
+            # (block_q, 128) tile each — the same [.., H] lane packing
+            # as the lse output, h x smaller than per-head tiles.
+            m_prev, l_prev = m_ref[:, hh:hh + 1], l_ref[:, hh:hh + 1]
+            m_cur = jnp.max(jnp.where(mask, s, NEG_INF), axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(jnp.where(mask, s - m_new, NEG_INF))
+            correction = jnp.exp(m_prev - m_new)
+            l_ref[:, hh:hh + 1] = (
+                correction * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            )
+            m_ref[:, hh:hh + 1] = m_new
+            acc_ref[hh] = acc_ref[hh] * correction + jax.lax.dot(
+                p.astype(v_ref.dtype), v_ref[0][:, hk * d:(hk + 1) * d],
+                preferred_element_type=jnp.float32,
+            )
+
+    if live is None:
+        compute()
+    else:
+        pl.when(live)(compute)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        for hh in range(h):
+            l = l_ref[:, hh:hh + 1]
+            safe_l = jnp.where(l > 0.0, l, 1.0)
+            o_ref[0, :, hh * d:(hh + 1) * d] = (
+                acc_ref[hh] / safe_l
+            ).astype(o_ref.dtype)
+            lse_ref[0, :, hh:hh + 1] = jnp.where(
+                l > 0.0, m_ref[:, hh:hh + 1] + jnp.log(safe_l), NEG_INF
+            )
+
+
+def _bwd_flat_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
+    *, sm_scale, causal, q_len, kv_len, block_q, block_k, h, d, groups,
+):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    mask, live = _block_mask(
+        i, j, None, None,
+        causal=causal, q_len=q_len, kv_len=kv_len,
+        block_q=block_q, block_k=block_k,
+    )
+
+    def compute():
+        for hh in range(h):
+            hk = hh // groups
+            kh = k_ref[0][:, hk * d:(hk + 1) * d]
+            p = _masked_p(
+                q_ref[0][:, hh * d:(hh + 1) * d], kh,
+                lse_ref[0][:, hh:hh + 1], mask, sm_scale,
+            )
+            dp = jax.lax.dot_general(
+                do_ref[0][:, hh * d:(hh + 1) * d],
+                v_ref[0][:, hk * d:(hk + 1) * d],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_ref[0][:, hh:hh + 1])
+            dq_acc_ref[hh] += sm_scale * jax.lax.dot(
+                ds.astype(kh.dtype), kh, preferred_element_type=jnp.float32
+            )
+
+    if live is None:
+        compute()
+    else:
+        pl.when(live)(compute)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        for hh in range(h):
+            dq_ref[0, :, hh * d:(hh + 1) * d] = dq_acc_ref[hh].astype(
+                dq_ref.dtype
+            )
+
+
+def _bwd_flat_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
+    *, sm_scale, causal, q_len, kv_len, block_q, block_k, h, d, groups,
+):
+    # Grid: (batch, k-blocks, q-blocks) — q innermost so dk/dv accumulate
+    # in VMEM across the whole contraction; ALL query heads (including a
+    # GQA group's members) are contracted by the in-kernel head loop.
+    j, i = pl.program_id(1), pl.program_id(2)
+    ne = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    mask, live = _block_mask(
+        i, j, None, None,
+        causal=causal, q_len=q_len, kv_len=kv_len,
+        block_q=block_q, block_k=block_k,
+    )
+
+    def compute():
+        for hh in range(h):
+            hk = hh // groups
+            qh = q_ref[0][:, hh * d:(hh + 1) * d]
+            doh = do_ref[0][:, hh * d:(hh + 1) * d]
+            p = _masked_p(
+                qh, k_ref[0][:, hk * d:(hk + 1) * d],
+                lse_ref[0][:, hh:hh + 1], mask, sm_scale,
+            )
+            dv_acc_ref[hk] += jax.lax.dot_general(
+                p.astype(doh.dtype), doh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                doh, v_ref[0][:, hk * d:(hk + 1) * d],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_ref[0][:, hh:hh + 1])
+            dk_acc_ref[hk] += sm_scale * jax.lax.dot_general(
+                ds.astype(qh.dtype), qh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    if live is None:
+        compute()
+    else:
+        pl.when(live)(compute)
+
+    @pl.when(i == ne - 1)
+    def _finalize():
+        h_kv = h // groups
+        for hk in range(h_kv):
+            dk_ref[0, :, hk * d:(hk + 1) * d] = dk_acc_ref[hk].astype(
+                dk_ref.dtype
+            )
+            dv_ref[0, :, hk * d:(hk + 1) * d] = dv_acc_ref[hk].astype(
+                dv_ref.dtype
+            )
+
+
+def _q_clamp_flat(active: bool, q_len: int, kv_len: int,
+                  block_q: int, block_k: int, nq: int):
+    """q-block index clamp for the flat dkv grid (its innermost axis is
+    the plain q-block index, no group encoding). Identity when inactive."""
+    if not active:
+        return lambda j, e: e
+    off = kv_len - q_len
+
+    def clamp(j, e):
+        first_live = (j * block_k - off) // block_q
+        return jnp.maximum(e, jnp.clip(first_live, 0, nq - 1))
+
+    return clamp
+
+
+def _flash_flat_fwd_impl(
+    qf, kf, vf, h, sm_scale, causal, block_q, block_k, interpret
+):
+    b, q_len, hd_total = qf.shape
+    d = hd_total // h
+    kv_len = kf.shape[1]
+    h_kv = kf.shape[-1] // d
+    groups = h // h_kv
+    qp = _pad_to(qf, 1, block_q)
+    kp = _pad_to(kf, 1, block_k)
+    vp = _pad_to(vf, 1, block_k)
+    nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
+
+    kernel = functools.partial(
+        _fwd_flat_kernel,
+        sm_scale=sm_scale, causal=causal, q_len=q_len, kv_len=kv_len,
+        block_q=block_q, block_k=block_k, h=h, d=d, groups=groups,
+    )
+    # Same dead-block DMA clamp as the [B,H,S,D] forward (see its note).
+    jc = _kv_clamp(causal, q_len, kv_len, block_q, block_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, h * d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, h_kv * d),
+                         lambda b, i, j: (b, jc(i, j), 0)),
+            pl.BlockSpec((1, block_k, h_kv * d),
+                         lambda b, i, j: (b, jc(i, j), 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, h * d), lambda b, i, j: (b, i, 0)),
+            # lse as [B, S, H]: trailing block dims (block_q, H-full) are
+            # legal, and the layout matches the operands' (no transposes
+            # anywhere on the stats path either).
+            pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qp.shape, qf.dtype, vma=jax.typeof(qp).vma),
+            jax.ShapeDtypeStruct(
+                (b, qp.shape[1], h), jnp.float32, vma=jax.typeof(qp).vma
+            ),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h, block_q, d), jnp.float32),
+            # m/l: per-head stats packed into lanes (head hh = lane hh)
+            # of ONE tile each; per-head 128-lane tiles would cost h x
+            # more VMEM for the same information.
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :q_len], lse[:, :q_len]
+
+
+def _flash_flat_bwd_impl(
+    qf, kf, vf, outf, lse, do, h,
+    sm_scale, causal, block_q, block_k, interpret,
+):
+    b, q_len, hd_total = qf.shape
+    d = hd_total // h
+    kv_len = kf.shape[1]
+    h_kv = kf.shape[-1] // d
+    groups = h // h_kv
+    # delta = rowsum(do·o) per head, straight into the [B, S, H] layout
+    # the kernels read — a fused reduce for XLA, no transposes.
+    delta = jnp.sum(
+        (do.astype(jnp.float32) * outf.astype(jnp.float32)).reshape(
+            b, q_len, h, d
+        ),
+        axis=-1,
+    )
+
+    qp = _pad_to(qf, 1, block_q)
+    kp = _pad_to(kf, 1, block_k)
+    vp = _pad_to(vf, 1, block_k)
+    dop = _pad_to(do, 1, block_q)
+    lsep = _pad_to(lse, 1, block_q)
+    deltap = _pad_to(delta, 1, block_q)
+    nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
+
+    common = dict(
+        sm_scale=sm_scale, causal=causal, q_len=q_len, kv_len=kv_len,
+        block_q=block_q, block_k=block_k, h=h, d=d, groups=groups,
+    )
+    operands = (qp, kp, vp, dop, lsep, deltap)
+    jc = _kv_clamp(causal, q_len, kv_len, block_q, block_k)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_flat_dq_kernel, **common),
+        grid=(b, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, h * d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, h_kv * d),
+                         lambda b, i, j: (b, jc(i, j), 0)),
+            pl.BlockSpec((1, block_k, h_kv * d),
+                         lambda b, i, j: (b, jc(i, j), 0)),
+            pl.BlockSpec((1, block_q, h * d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, h * d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            qp.shape, qf.dtype, vma=jax.typeof(qp).vma
+        ),
+        scratch_shapes=[pltpu.VMEM((h, block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(*operands)
+
+    ec = _q_clamp_flat(causal, q_len, kv_len, block_q, block_k, nq)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_flat_dkv_kernel, **common),
+        grid=(b, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, h * d), lambda b, j, e: (b, ec(j, e), 0)),
+            pl.BlockSpec((1, block_k, h_kv * d), lambda b, j, e: (b, j, 0)),
+            pl.BlockSpec((1, block_k, h_kv * d), lambda b, j, e: (b, j, 0)),
+            pl.BlockSpec((1, block_q, h * d), lambda b, j, e: (b, ec(j, e), 0)),
+            pl.BlockSpec((1, block_q, h), lambda b, j, e: (b, ec(j, e), 0)),
+            pl.BlockSpec((1, block_q, h), lambda b, j, e: (b, ec(j, e), 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, h_kv * d), lambda b, j, e: (b, j, 0)),
+            pl.BlockSpec((1, block_k, h_kv * d), lambda b, j, e: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(kp.shape, kf.dtype, vma=jax.typeof(kp).vma),
+            jax.ShapeDtypeStruct(vp.shape, vf.dtype, vma=jax.typeof(vp).vma),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h_kv, block_k, d), jnp.float32),
+            pltpu.VMEM((h_kv, block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(*operands)
+    return dq[:, :q_len], dk[:, :kv_len], dv[:, :kv_len]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_flat(qf, kf, vf, h, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_flat_fwd_impl(
+        qf, kf, vf, h, sm_scale, causal, block_q, block_k, interpret
+    )
+    return out
+
+
+def _flash_flat_fwd(qf, kf, vf, h, sm_scale, causal, block_q, block_k,
+                    interpret):
+    out, lse = _flash_flat_fwd_impl(
+        qf, kf, vf, h, sm_scale, causal, block_q, block_k, interpret
+    )
+    return out, (qf, kf, vf, out, lse)
+
+
+def _flash_flat_bwd(h, sm_scale, causal, block_q, block_k, interpret,
+                    res, do):
+    qf, kf, vf, out, lse = res
+    return _flash_flat_bwd_impl(
+        qf, kf, vf, out, lse, do, h,
+        sm_scale, causal, block_q, block_k, interpret,
+    )
+
+
+_flash_flat.defvjp(_flash_flat_fwd, _flash_flat_bwd)
+
+
+def flash_attention_bshd(
+    q, k, v,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Flash attention over the PROJECTION layout: q [B, Sq, H, D];
+    k, v [B, Sk, Hkv, D] → [B, Sq, H, D] — the layout nn.Dense/RoPE
+    already produce, so the host program has ZERO transposes around the
+    kernel (the [B, H, S, D] path forces materialized layout copies on
+    q/k/v/out, forward and backward, every layer — 12.5 GB/step on the
+    BERT bench program, see PERF.md).
+
+    GQA (Hkv dividing H), custom VJP (all three passes pallas), and
+    interpret-mode fallback exactly as :func:`flash_attention`; the two
+    are value-equivalent up to a transpose of the operands.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected [B, S, H, D] inputs, got rank {q.ndim}")
+    b, q_len, h, d = q.shape
+    kv_len, h_kv = k.shape[1], k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    if h > 128:
+        raise ValueError(
+            f"flash_attention_bshd lane-packs per-head stats (<=128 "
+            f"heads); got {h} — use flash_attention for wider models"
+        )
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+    block_q = min(block_q, max(q_len, 1))
+    block_k = min(block_k, max(kv_len, 1))
+    out = _flash_flat(
+        q.reshape(b, q_len, h * d),        # free: H, D are contiguous
+        k.reshape(b, kv_len, h_kv * d),
+        v.reshape(b, kv_len, h_kv * d),
+        h, sm_scale, causal, block_q, block_k, interpret,
+    )
+    return out.reshape(b, q_len, h, d)
+
+
 def flash_attention(
     q, k, v,
     *,

@@ -239,15 +239,19 @@ def sp_attention(
     block_k: int = 128,
 ):
     """The single attention dispatch for model code (llama, bert):
-    'flash' (pallas kernel), 'dense' (XLA reference; GQA kv heads are
-    expanded here since the reference has no grouped path), 'ring'
-    (sequence-parallel ppermute ring over sp; honors ``zigzag`` for
-    causal balance), or 'ulysses' (all-to-all sequence parallelism).
-    Unknown names raise — a typo must not silently train the dense
-    path. Operands are [B, H, S, D]."""
+    'flash'/'flash-bhsd' (pallas kernel over this [B, H, S, D]
+    convention — model code routes 'flash' to the projection-layout
+    kernel BEFORE transposing and only reaches here already-transposed,
+    e.g. from ring hops; 'flash-bhsd' is the explicit hardware-A/B
+    name), 'dense' (XLA reference; GQA kv heads are expanded here since
+    the reference has no grouped path), 'ring' (sequence-parallel
+    ppermute ring over sp; honors ``zigzag`` for causal balance), or
+    'ulysses' (all-to-all sequence parallelism). Unknown names raise —
+    a typo must not silently train the dense path. Operands are
+    [B, H, S, D]."""
     from .attention import attention_reference, flash_attention
 
-    if impl == "flash":
+    if impl in ("flash", "flash-bhsd"):
         return flash_attention(
             q, k, v, causal=causal, block_q=block_q, block_k=block_k
         )

@@ -14,6 +14,7 @@ import pytest
 from mpi_operator_tpu.ops import (
     attention_reference,
     flash_attention,
+    flash_attention_bshd,
     flash_attention_lse,
     ring_attention,
     ring_attention_sharded,
@@ -154,6 +155,124 @@ class TestFlashAttention:
         _, k, v = _qkv(b=1, h=2, sq=128, d=128)
         with pytest.raises(ValueError, match="not a multiple"):
             flash_attention(q, k, v)
+
+
+class TestFlashAttentionBshd:
+    """Projection-layout ([B, S, H, D]) kernels — the zero-layout-copy
+    path the transformer models default to. Value-equal to the
+    [B, H, S, D] kernels up to a transpose of the operands."""
+
+    @staticmethod
+    def _bshd(x):
+        return x.transpose(0, 2, 1, 3)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv(b=2, h=3, sq=256, d=64)
+        out = flash_attention_bshd(
+            self._bshd(q), self._bshd(k), self._bshd(v), causal=causal
+        )
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            self._bshd(out), ref, atol=2e-5, rtol=2e-5
+        )
+
+    @pytest.mark.parametrize("sq,sk,bq,bk", [
+        (256, 256, 64, 128),   # mismatched tiles
+        (200, 200, 128, 64),   # non-divisible seq (padding + clamp)
+        (128, 256, 64, 64),    # causal cross lengths (off != 0)
+    ])
+    def test_causal_gradients_across_tilings(self, sq, sk, bq, bk):
+        """The flat dkv grid uses its own q-block clamp
+        (ops/attention.py:_q_clamp_flat) — causal gradients must stay
+        equal to the dense reference for every tiling/padding/offset."""
+        q, k, v = _qkv(sq=sq, sk=sk, d=64)
+
+        def loss_flat(q, k, v):
+            return jnp.sum(
+                flash_attention_bshd(
+                    self._bshd(q), self._bshd(k), self._bshd(v),
+                    causal=True, block_q=bq, block_k=bk,
+                ) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+        g_flat = jax.grad(loss_flat, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for got, want, name in zip(g_flat, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                got, want, atol=5e-4, rtol=1e-3, err_msg=f"d{name} mismatch"
+            )
+
+    @pytest.mark.parametrize("sq,sk", [(256, 256), (200, 200)])
+    def test_noncausal_gradients(self, sq, sk):
+        """BERT trains through exactly this path (causal=False incl.
+        padding masks) — gradient parity must hold, not just forward."""
+        q, k, v = _qkv(sq=sq, sk=sk, d=64)
+
+        def loss_flat(q, k, v):
+            return jnp.sum(
+                flash_attention_bshd(
+                    self._bshd(q), self._bshd(k), self._bshd(v),
+                    causal=False,
+                ) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=False) ** 2)
+
+        g_flat = jax.grad(loss_flat, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for got, want, name in zip(g_flat, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                got, want, atol=5e-4, rtol=1e-3, err_msg=f"d{name} mismatch"
+            )
+
+    def test_gqa_matches_and_grads(self):
+        # 4 query heads on 2 kv heads: the in-kernel head loop contracts
+        # a whole GQA group into each kv head's dk/dv accumulator.
+        q, _, _ = _qkv(b=2, h=4, sq=256, d=32)
+        _, k, v = _qkv(b=2, h=2, sq=256, d=32, seed=1)
+
+        def loss_flat(q, k, v):
+            return jnp.sum(
+                flash_attention_bshd(
+                    self._bshd(q), self._bshd(k), self._bshd(v), causal=True
+                ) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            ke, ve = jnp.repeat(k, 2, axis=1), jnp.repeat(v, 2, axis=1)
+            return jnp.sum(attention_reference(q, ke, ve, causal=True) ** 2)
+
+        g_flat = jax.grad(loss_flat, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        assert g_flat[1].shape == k.shape
+        for got, want, name in zip(g_flat, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                got, want, atol=5e-4, rtol=1e-3, err_msg=f"d{name} mismatch"
+            )
+
+    def test_bf16_and_jit(self):
+        q, k, v = _qkv(b=1, h=2, sq=128, d=64, dtype=jnp.bfloat16)
+        f = jax.jit(
+            lambda q, k, v: flash_attention_bshd(q, k, v, causal=True)
+        )
+        out = f(self._bshd(q), self._bshd(k), self._bshd(v))
+        assert out.dtype == jnp.bfloat16
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            self._bshd(out).astype(np.float32), ref.astype(np.float32),
+            atol=3e-2, rtol=3e-2,
+        )
+
+    def test_rejects_non_divisible_gqa(self):
+        q = jnp.zeros((1, 128, 3, 32))
+        k = v = jnp.zeros((1, 128, 2, 32))
+        with pytest.raises(ValueError, match="not a multiple"):
+            flash_attention_bshd(q, k, v)
 
 
 class TestBlockSizeInvariance:

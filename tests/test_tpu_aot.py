@@ -103,6 +103,36 @@ class TestMosaicLowering:
         """)
 
     @pytest.mark.e2e
+    def test_flash_bshd_flat_kernels_compile(self):
+        """The projection-layout kernels' whole point is Mosaic-level:
+        blocks (1, block_q, H·D) with an in-kernel per-head lane-slice
+        loop (incl. the d=64 half-lane offsets of bert's head_dim) must
+        lower. Covers fwd + dq + dkv at both bert- and llama-like
+        shapes, GQA included."""
+        _aot("""
+            import importlib
+            import mpi_operator_tpu.ops.attention as att
+            importlib.reload(att)
+
+            for (b, s, h, hkv, d, causal) in [
+                (1, 512, 12, 12, 64, False),   # bert-base shape
+                (1, 1024, 16, 8, 128, True),   # llama shape (GQA)
+            ]:
+                q = sds((b, s, h, d), jnp.bfloat16)
+                kv = sds((b, s, hkv, d), jnp.bfloat16)
+
+                def loss(q, k, v):
+                    return jnp.sum(att.flash_attention_bshd(
+                        q, k, v, causal=causal
+                    ) ** 2)
+
+                jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(
+                    q, kv, kv
+                ).compile()
+            print("AOT_OK")
+        """, timeout=600)
+
+    @pytest.mark.e2e
     def test_bn_kernels_compile(self):
         _aot("""
             import importlib
