@@ -193,7 +193,19 @@ def remat_policy_for(cfg: "LlamaConfig"):
     if cfg.remat_policy == "full":
         return None
     if cfg.remat_policy == "dots":
-        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        # dots alone discards the flash kernels' (out, lse) residuals —
+        # they are custom-call outputs, not dots — so the attention
+        # FORWARD kernel reruns inside every backward. Save them by
+        # name too (ops/attention.py tags them): O(S·H·D) extra bytes
+        # per layer buys back a whole attention forward per layer.
+        from ..ops.attention import ATTN_LSE_NAME, ATTN_OUT_NAME
+
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                ATTN_OUT_NAME, ATTN_LSE_NAME
+            ),
+        )
     raise ValueError(
         f"remat_policy must be 'full' or 'dots', got {cfg.remat_policy!r}"
     )

@@ -32,6 +32,26 @@ from ._common import use_interpret as _use_interpret
 
 NEG_INF = -1e30  # safe "minus infinity": avoids inf-inf → nan in masking
 
+# Residual names for remat policies. The flash kernels' backward needs
+# (out, lse), but neither is a dot output, so the standard
+# dots_with_no_batch_dims_saveable policy discards them and the whole
+# forward kernel RERUNS inside the backward — one extra attention
+# forward per layer per step. Naming them lets
+# models.llama.remat_policy_for extend the dots policy to save exactly
+# these two tensors (O(S·H·D) + O(S·H) per layer — the cheap ones; the
+# O(S²) score matrix never exists in either pass).
+ATTN_OUT_NAME = "flash_attn_out"
+ATTN_LSE_NAME = "flash_attn_lse"
+
+
+def _name_attn_residuals(out, lse):
+    from jax.ad_checkpoint import checkpoint_name
+
+    return (
+        checkpoint_name(out, ATTN_OUT_NAME),
+        checkpoint_name(lse, ATTN_LSE_NAME),
+    )
+
 # Sentinel ids used to encode padding inside explicit row/col id vectors:
 # padded k/v columns get +_ID_PAD (never visible to any row), padded q rows
 # get -_ID_PAD (see nothing; their output is sliced away by the wrapper).
@@ -537,6 +557,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     out, lse = _flash_fwd_impl(
         q, k, v, None, None, sm_scale, causal, block_q, block_k, interpret
     )
+    out, lse = _name_attn_residuals(out, lse)
     return out, (q, k, v, out, lse)
 
 
@@ -962,6 +983,7 @@ def _flash_flat_fwd(qf, kf, vf, h, sm_scale, causal, block_q, block_k,
     out, lse = _flash_flat_fwd_impl(
         qf, kf, vf, h, sm_scale, causal, block_q, block_k, interpret
     )
+    out, lse = _name_attn_residuals(out, lse)
     return out, (qf, kf, vf, out, lse)
 
 

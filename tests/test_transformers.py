@@ -186,6 +186,33 @@ class TestLlama:
                             jax.tree_util.tree_leaves(results[name][1])):
                 np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
 
+    def test_dots_policy_saves_flash_forward(self):
+        """remat_policy='dots' names the flash kernels' (out, lse) as
+        saveable (ops/attention.py:ATTN_*_NAME): the attention FORWARD
+        must not rerun inside the backward. Counted at the jaxpr level:
+        per layer, exactly fwd + dq + dkv pallas calls — a fourth call
+        per layer is the recompute this policy exists to eliminate
+        (remat='full' keeps it deliberately, minimum-memory mode)."""
+        tokens = _tokens(np.random.RandomState(0), 2, 64, 256)
+
+        def count(policy):
+            cfg = llama_lib.tiny(
+                attention_impl="flash", remat=True, remat_policy=policy,
+                n_heads=4, n_kv_heads=2, dim=64,
+            )
+            model = llama_lib.Llama(cfg)
+            params = llama_lib.init_params(
+                model, jax.random.PRNGKey(0), batch=2, seq=64
+            )
+            jaxpr = jax.make_jaxpr(
+                jax.grad(lambda p: llama_lib.loss_fn(model, p, tokens))
+            )(params)
+            return str(jaxpr).count("pallas_call")
+
+        n_layers = 2  # llama tiny
+        assert count("dots") == 3 * n_layers  # fwd + dq + dkv per layer
+        assert count("full") == 4 * n_layers  # + the deliberate recompute
+
     def test_remat_policy_rejects_unknown(self):
         cfg = llama_lib.tiny(remat=True, remat_policy="bogus")
         model = llama_lib.Llama(cfg)
