@@ -62,6 +62,12 @@ run bert-dense-attn    --suite bert --attention-impl dense
 run llama-dense-attn   --suite llama --attention-impl dense
 # Batch-8 via bf16 adam first moment (no extra FLOPs; fits 16G).
 run llama-b8-mu-bf16   --suite llama --llama-batch 8 --adam-mu-dtype bf16
+# Tile sweep headliners (the full sweep runs last via tpu_tune, but the
+# tunnel can die mid-window — capture the single most promising point
+# of each suite early: larger q-tiles divide the flash kernels' k/v
+# re-read, the dominant kernel-internal DMA).
+run bert-fb512         --suite bert --flash-block-q 512 --flash-block-k 512
+run llama-fb256        --suite llama --flash-block-q 256 --flash-block-k 256
 # ResNet A/Bs: scanned stages (compile-friendly form) and pallas BN.
 # Chipless-AOT analysis (docs/round3-notes.md) localized round 3's
 # 29-min "hang" to the eager-init kernel storm (fixed: init is jitted)
