@@ -85,6 +85,20 @@ class EncoderLayer(nn.Module):
                 q, k, v, causal=False,
                 block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
             ).reshape(b, s, cfg.dim)
+        elif cfg.attention_impl == "ulysses":
+            # Sequence-parallel twin of the flat path (transpose-free
+            # all-to-all re-shard; ops/ulysses.py).
+            from ..parallel.mesh import SP
+            from ..ops.ulysses import ulysses_attention_bshd_shard_mapped
+
+            if self.mesh is None or SP not in self.mesh.axis_names:
+                raise ValueError(
+                    "attention_impl='ulysses' needs a mesh with an sp axis"
+                )
+            att = ulysses_attention_bshd_shard_mapped(
+                q, k, v, self.mesh, causal=False,
+                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+            ).reshape(b, s, cfg.dim)
         else:
             # [B, H, S, D] convention (flash-bhsd A/B, dense oracle,
             # and the sequence-parallel strategies).

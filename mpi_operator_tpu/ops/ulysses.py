@@ -40,8 +40,14 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ..parallel.mesh import SP
-from .attention import attention_reference, flash_attention
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import DP, FSDP, SP, TP
+from .attention import (
+    attention_reference,
+    flash_attention,
+    flash_attention_bshd,
+)
 from .ring_attention import ring_spec, sp_attention_specs
 
 
@@ -153,6 +159,114 @@ def ulysses_attention_shard_mapped(
         # Same vma workaround as ring_attention_shard_mapped: pallas in
         # shard_map trips jax's varying-manual-axes tracking in interpret
         # mode; correctness is covered by the dense-oracle tests.
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def bshd_spec(mesh, axis: str = SP, n_heads: Optional[int] = None):
+    """PartitionSpec for [B, S, H, D] projection-layout operands: batch
+    over dp×fsdp, sequence over the sp axis, heads over tp when the
+    head count divides it — ``ring_spec``'s twin for the flat layout."""
+    names = mesh.axis_names
+    batch_axes = tuple(a for a in (DP, FSDP) if a in names)
+    head_axis = None
+    if n_heads is not None and TP in names:
+        tp_size = dict(zip(names, mesh.devices.shape))[TP]
+        if tp_size > 1 and n_heads % tp_size == 0:
+            head_axis = TP
+    return P(batch_axes if batch_axes else None, axis, head_axis, None)
+
+
+def bshd_sp_specs(mesh, q_heads: int, kv_heads: int, axis: str = SP):
+    """(q_spec, kv_spec) for projection-layout sequence-parallel
+    operands (``sp_attention_specs``'s twin): heads ride tp only when
+    tp divides BOTH head counts."""
+    tp_ok = (
+        bshd_spec(mesh, axis, q_heads)[2] == TP
+        and bshd_spec(mesh, axis, kv_heads)[2] == TP
+    )
+    q_spec = bshd_spec(mesh, axis, q_heads if tp_ok else None)
+    kv_spec = bshd_spec(mesh, axis, kv_heads if tp_ok else None)
+    return q_spec, kv_spec
+
+
+def ulysses_attention_bshd(
+    q, k, v,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    """Per-shard Ulysses attention over the PROJECTION layout — the
+    sequence-parallel twin of ``attention.flash_attention_bshd``.
+
+    q: [B, S_local, H, D]; k, v: [B, S_local, H_kv, D], sequence-sharded
+    contiguously over ``axis_name``. The all-to-alls re-shard
+    [B, S/n, H, D] → [B, S, H/n, D] (split heads, concat sequence) and
+    back, and the dense flash call in the middle is the flat kernel —
+    so the WHOLE sequence-parallel attention path, collectives
+    included, runs with zero host-side layout changes (the [B, H, S, D]
+    variant pays materialized transposes around every call, PERF.md)."""
+    n = jax.lax.axis_size(axis_name)
+    h, h_kv = q.shape[2], k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    if h % n:
+        raise ValueError(
+            f"ulysses needs the sp size ({n}) to divide the query head "
+            f"count ({h}); use ring attention for sp > heads"
+        )
+    if h_kv % n:
+        rep = _replicate_kv_for(h_kv, n)
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    if n > 1:
+        a2a = lambda x: jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+        q, k, v = a2a(q), a2a(k), a2a(v)
+
+    out = flash_attention_bshd(
+        q, k, v, causal=causal, sm_scale=sm_scale,
+        block_q=block_q, block_k=block_k,
+    )
+
+    if n > 1:
+        out = jax.lax.all_to_all(
+            out, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+    return out
+
+
+def ulysses_attention_bshd_shard_mapped(
+    q, k, v,
+    mesh,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    axis: str = SP,
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    """shard_map of the projection-layout Ulysses kernel — what the
+    models' ``attention_impl='ulysses'`` now calls directly on the raw
+    [B, S, H, D] projections (no transposes before or after)."""
+    from jax import shard_map
+
+    q_spec, kv_spec = bshd_sp_specs(mesh, q.shape[2], k.shape[2], axis)
+    fn = shard_map(
+        lambda a, b, c: ulysses_attention_bshd(
+            a, b, c, axis, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k,
+        ),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+        # Same vma workaround as ring_attention_shard_mapped.
         check_vma=False,
     )
     return fn(q, k, v)
