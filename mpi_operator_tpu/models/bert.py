@@ -85,20 +85,33 @@ class EncoderLayer(nn.Module):
                 q, k, v, causal=False,
                 block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
             ).reshape(b, s, cfg.dim)
-        elif cfg.attention_impl == "ulysses":
-            # Sequence-parallel twin of the flat path (transpose-free
-            # all-to-all re-shard; ops/ulysses.py).
+        elif cfg.attention_impl in ("ulysses", "ring"):
+            # Sequence-parallel twins of the flat path (transpose-free
+            # collectives; ops/ulysses.py, ops/ring_attention.py).
             from ..parallel.mesh import SP
-            from ..ops.ulysses import ulysses_attention_bshd_shard_mapped
 
             if self.mesh is None or SP not in self.mesh.axis_names:
                 raise ValueError(
-                    "attention_impl='ulysses' needs a mesh with an sp axis"
+                    f"attention_impl={cfg.attention_impl!r} needs a mesh "
+                    f"with an sp axis"
                 )
-            att = ulysses_attention_bshd_shard_mapped(
-                q, k, v, self.mesh, causal=False,
-                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
-            ).reshape(b, s, cfg.dim)
+            if cfg.attention_impl == "ulysses":
+                from ..ops.ulysses import ulysses_attention_bshd_shard_mapped
+
+                att = ulysses_attention_bshd_shard_mapped(
+                    q, k, v, self.mesh, causal=False,
+                    block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                )
+            else:
+                from ..ops.ring_attention import (
+                    ring_attention_bshd_shard_mapped,
+                )
+
+                att = ring_attention_bshd_shard_mapped(
+                    q, k, v, self.mesh, causal=False,
+                    block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                )
+            att = att.reshape(b, s, cfg.dim)
         else:
             # [B, H, S, D] convention (flash-bhsd A/B, dense oracle,
             # and the sequence-parallel strategies).

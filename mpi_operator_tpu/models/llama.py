@@ -257,20 +257,34 @@ class Attention(nn.Module):
                 block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
             ).reshape(b, s, cfg.n_heads * hd)
             return dense(cfg.dim, "wo")(out)
-        if cfg.attention_impl == "ulysses":
-            # Sequence-parallel twin of the flat path: the all-to-alls
-            # re-shard the projection layout directly, so long-context
-            # sp runs are also transpose-free end to end.
-            from ..ops.ulysses import ulysses_attention_bshd_shard_mapped
-
+        if cfg.attention_impl in ("ulysses", "ring"):
+            # Sequence-parallel twins of the flat path: the collectives
+            # (all-to-alls / ppermute hops) move the projection layout
+            # directly, so long-context sp runs are also transpose-free
+            # end to end.
             if self.mesh is None or SP not in self.mesh.axis_names:
                 raise ValueError(
-                    "attention_impl='ulysses' needs a mesh with an sp axis"
+                    f"attention_impl={cfg.attention_impl!r} needs a mesh "
+                    f"with an sp axis"
                 )
-            out = ulysses_attention_bshd_shard_mapped(
-                q, k, v, self.mesh, causal=True,
-                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
-            ).reshape(b, s, cfg.n_heads * hd)
+            if cfg.attention_impl == "ulysses":
+                from ..ops.ulysses import ulysses_attention_bshd_shard_mapped
+
+                out = ulysses_attention_bshd_shard_mapped(
+                    q, k, v, self.mesh, causal=True,
+                    block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                )
+            else:
+                from ..ops.ring_attention import (
+                    ring_attention_bshd_shard_mapped,
+                )
+
+                out = ring_attention_bshd_shard_mapped(
+                    q, k, v, self.mesh, causal=True,
+                    zigzag=_use_zigzag(cfg, self.mesh),
+                    block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                )
+            out = out.reshape(b, s, cfg.n_heads * hd)
             return dense(cfg.dim, "wo")(out)
 
         # [B, H, S, D] layout. flash-bhsd (the transpose-convention

@@ -634,9 +634,16 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _fwd_flat_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, sm_scale, causal, q_len, kv_len, block_q, block_k, h, d, groups,
+    *refs,
+    sm_scale, causal, use_ids, q_len, kv_len, block_q, block_k, h, d, groups,
 ):
+    if use_ids:
+        q_ref, k_ref, v_ref, row_ref, col_ref = refs[:5]
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[5:]
+    else:
+        q_ref, k_ref, v_ref = refs[:3]
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[3:]
+        row_ref = col_ref = None
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -647,7 +654,7 @@ def _fwd_flat_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
 
     mask, live = _block_mask(
-        i, j, None, None,
+        i, j, row_ref, col_ref,
         causal=causal, q_len=q_len, kv_len=kv_len,
         block_q=block_q, block_k=block_k,
     )
@@ -697,9 +704,17 @@ def _fwd_flat_kernel(
 
 
 def _bwd_flat_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
-    *, sm_scale, causal, q_len, kv_len, block_q, block_k, h, d, groups,
+    *refs,
+    sm_scale, causal, use_ids, q_len, kv_len, block_q, block_k, h, d, groups,
 ):
+    if use_ids:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         row_ref, col_ref) = refs[:8]
+        dq_ref, dq_acc_ref = refs[8:]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+        dq_ref, dq_acc_ref = refs[6:]
+        row_ref = col_ref = None
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -708,7 +723,7 @@ def _bwd_flat_dq_kernel(
         dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
 
     mask, live = _block_mask(
-        i, j, None, None,
+        i, j, row_ref, col_ref,
         causal=causal, q_len=q_len, kv_len=kv_len,
         block_q=block_q, block_k=block_k,
     )
@@ -746,13 +761,20 @@ def _bwd_flat_dq_kernel(
 
 
 def _bwd_flat_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
-    *, sm_scale, causal, q_len, kv_len, block_q, block_k, h, d, groups,
+    *refs,
+    sm_scale, causal, use_ids, q_len, kv_len, block_q, block_k, h, d, groups,
 ):
     # Grid: (batch, k-blocks, q-blocks) — q innermost so dk/dv accumulate
     # in VMEM across the whole contraction; ALL query heads (including a
     # GQA group's members) are contracted by the in-kernel head loop.
+    if use_ids:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         row_ref, col_ref) = refs[:8]
+        dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = refs[8:]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+        dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = refs[6:]
+        row_ref = col_ref = None
     j, i = pl.program_id(1), pl.program_id(2)
     ne = pl.num_programs(2)
 
@@ -762,7 +784,7 @@ def _bwd_flat_dkv_kernel(
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
     mask, live = _block_mask(
-        i, j, None, None,
+        i, j, row_ref, col_ref,
         causal=causal, q_len=q_len, kv_len=kv_len,
         block_q=block_q, block_k=block_k,
     )
@@ -824,13 +846,15 @@ def _q_clamp_flat(active: bool, q_len: int, kv_len: int,
 
 
 def _flash_flat_fwd_impl(
-    qf, kf, vf, h, sm_scale, causal, block_q, block_k, interpret
+    qf, kf, vf, row_ids, col_ids, h, sm_scale, causal, block_q, block_k,
+    interpret,
 ):
     b, q_len, hd_total = qf.shape
     d = hd_total // h
     kv_len = kf.shape[1]
     h_kv = kf.shape[-1] // d
     groups = h // h_kv
+    use_ids = row_ids is not None
     qp = _pad_to(qf, 1, block_q)
     kp = _pad_to(kf, 1, block_k)
     vp = _pad_to(vf, 1, block_k)
@@ -838,21 +862,34 @@ def _flash_flat_fwd_impl(
 
     kernel = functools.partial(
         _fwd_flat_kernel,
-        sm_scale=sm_scale, causal=causal, q_len=q_len, kv_len=kv_len,
+        sm_scale=sm_scale, causal=causal, use_ids=use_ids,
+        q_len=q_len, kv_len=kv_len,
         block_q=block_q, block_k=block_k, h=h, d=d, groups=groups,
     )
-    # Same dead-block DMA clamp as the [B,H,S,D] forward (see its note).
-    jc = _kv_clamp(causal, q_len, kv_len, block_q, block_k)
+    # Same dead-block DMA clamp as the [B,H,S,D] forward (see its note);
+    # id-based runs keep the plain map (data-dependent live set).
+    jc = _kv_clamp(causal and not use_ids, q_len, kv_len, block_q, block_k)
+    in_specs = [
+        pl.BlockSpec((1, block_q, h * d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, h_kv * d),
+                     lambda b, i, j: (b, jc(i, j), 0)),
+        pl.BlockSpec((1, block_k, h_kv * d),
+                     lambda b, i, j: (b, jc(i, j), 0)),
+    ]
+    operands = [qp, kp, vp]
+    if use_ids:
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, i, j: (0, i)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (0, j)),
+        ]
+        operands += [
+            _pad_ids(row_ids, block_q, -_ID_PAD),
+            _pad_ids(col_ids, block_k, _ID_PAD),
+        ]
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, h * d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, h_kv * d),
-                         lambda b, i, j: (b, jc(i, j), 0)),
-            pl.BlockSpec((1, block_k, h_kv * d),
-                         lambda b, i, j: (b, jc(i, j), 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, h * d), lambda b, i, j: (b, i, 0)),
             # lse as [B, S, H]: trailing block dims (block_q, H-full) are
@@ -878,12 +915,12 @@ def _flash_flat_fwd_impl(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(qp, kp, vp)
+    )(*operands)
     return out[:, :q_len], lse[:, :q_len]
 
 
 def _flash_flat_bwd_impl(
-    qf, kf, vf, outf, lse, do, h,
+    qf, kf, vf, outf, lse, do, dlse, row_ids, col_ids, h,
     sm_scale, causal, block_q, block_k, interpret,
 ):
     b, q_len, hd_total = qf.shape
@@ -891,14 +928,18 @@ def _flash_flat_bwd_impl(
     kv_len = kf.shape[1]
     h_kv = kf.shape[-1] // d
     groups = h // h_kv
+    use_ids = row_ids is not None
     # delta = rowsum(do·o) per head, straight into the [B, S, H] layout
-    # the kernels read — a fused reduce for XLA, no transposes.
+    # the kernels read — a fused reduce for XLA, no transposes. A
+    # cotangent on lse folds in with a minus sign (see _flash_bwd_impl).
     delta = jnp.sum(
         (do.astype(jnp.float32) * outf.astype(jnp.float32)).reshape(
             b, q_len, h, d
         ),
         axis=-1,
     )
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     qp = _pad_to(qf, 1, block_q)
     kp = _pad_to(kf, 1, block_k)
@@ -909,24 +950,37 @@ def _flash_flat_bwd_impl(
     nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
 
     common = dict(
-        sm_scale=sm_scale, causal=causal, q_len=q_len, kv_len=kv_len,
+        sm_scale=sm_scale, causal=causal, use_ids=use_ids,
+        q_len=q_len, kv_len=kv_len,
         block_q=block_q, block_k=block_k, h=h, d=d, groups=groups,
     )
-    operands = (qp, kp, vp, dop, lsep, deltap)
-    jc = _kv_clamp(causal, q_len, kv_len, block_q, block_k)
+    operands = [qp, kp, vp, dop, lsep, deltap]
+    id_operands = []
+    if use_ids:
+        id_operands = [
+            _pad_ids(row_ids, block_q, -_ID_PAD),
+            _pad_ids(col_ids, block_k, _ID_PAD),
+        ]
+    jc = _kv_clamp(causal and not use_ids, q_len, kv_len, block_q, block_k)
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, h * d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, h_kv * d),
+                     lambda b, i, j: (b, jc(i, j), 0)),
+        pl.BlockSpec((1, block_k, h_kv * d),
+                     lambda b, i, j: (b, jc(i, j), 0)),
+        pl.BlockSpec((1, block_q, h * d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
+    ]
+    if use_ids:
+        dq_in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, i, j: (0, i)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (0, j)),
+        ]
     dq = pl.pallas_call(
         functools.partial(_bwd_flat_dq_kernel, **common),
         grid=(b, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, h * d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, h_kv * d),
-                         lambda b, i, j: (b, jc(i, j), 0)),
-            pl.BlockSpec((1, block_k, h_kv * d),
-                         lambda b, i, j: (b, jc(i, j), 0)),
-            pl.BlockSpec((1, block_q, h * d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, h * d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(
             qp.shape, qf.dtype, vma=jax.typeof(qp).vma
@@ -936,20 +990,27 @@ def _flash_flat_bwd_impl(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(*operands)
+    )(*operands, *id_operands)
 
-    ec = _q_clamp_flat(causal, q_len, kv_len, block_q, block_k, nq)
+    ec = _q_clamp_flat(causal and not use_ids, q_len, kv_len,
+                       block_q, block_k, nq)
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, h * d), lambda b, j, e: (b, ec(j, e), 0)),
+        pl.BlockSpec((1, block_k, h_kv * d), lambda b, j, e: (b, j, 0)),
+        pl.BlockSpec((1, block_k, h_kv * d), lambda b, j, e: (b, j, 0)),
+        pl.BlockSpec((1, block_q, h * d), lambda b, j, e: (b, ec(j, e), 0)),
+        pl.BlockSpec((1, block_q, h), lambda b, j, e: (b, ec(j, e), 0)),
+        pl.BlockSpec((1, block_q, h), lambda b, j, e: (b, ec(j, e), 0)),
+    ]
+    if use_ids:
+        dkv_in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, j, e: (0, ec(j, e))),
+            pl.BlockSpec((1, block_k), lambda b, j, e: (0, j)),
+        ]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_flat_dkv_kernel, **common),
         grid=(b, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, h * d), lambda b, j, e: (b, ec(j, e), 0)),
-            pl.BlockSpec((1, block_k, h_kv * d), lambda b, j, e: (b, j, 0)),
-            pl.BlockSpec((1, block_k, h_kv * d), lambda b, j, e: (b, j, 0)),
-            pl.BlockSpec((1, block_q, h * d), lambda b, j, e: (b, ec(j, e), 0)),
-            pl.BlockSpec((1, block_q, h), lambda b, j, e: (b, ec(j, e), 0)),
-            pl.BlockSpec((1, block_q, h), lambda b, j, e: (b, ec(j, e), 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, h_kv * d), lambda b, j, e: (b, j, 0)),
             pl.BlockSpec((1, block_k, h_kv * d), lambda b, j, e: (b, j, 0)),
@@ -966,14 +1027,15 @@ def _flash_flat_bwd_impl(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(*operands)
+    )(*operands, *id_operands)
     return dq[:, :q_len], dk[:, :kv_len], dv[:, :kv_len]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_flat(qf, kf, vf, h, sm_scale, causal, block_q, block_k, interpret):
     out, _ = _flash_flat_fwd_impl(
-        qf, kf, vf, h, sm_scale, causal, block_q, block_k, interpret
+        qf, kf, vf, None, None, h, sm_scale, causal, block_q, block_k,
+        interpret,
     )
     return out
 
@@ -981,7 +1043,8 @@ def _flash_flat(qf, kf, vf, h, sm_scale, causal, block_q, block_k, interpret):
 def _flash_flat_fwd(qf, kf, vf, h, sm_scale, causal, block_q, block_k,
                     interpret):
     out, lse = _flash_flat_fwd_impl(
-        qf, kf, vf, h, sm_scale, causal, block_q, block_k, interpret
+        qf, kf, vf, None, None, h, sm_scale, causal, block_q, block_k,
+        interpret,
     )
     out, lse = _name_attn_residuals(out, lse)
     return out, (qf, kf, vf, out, lse)
@@ -991,12 +1054,109 @@ def _flash_flat_bwd(h, sm_scale, causal, block_q, block_k, interpret,
                     res, do):
     qf, kf, vf, out, lse = res
     return _flash_flat_bwd_impl(
-        qf, kf, vf, out, lse, do, h,
+        qf, kf, vf, out, lse, do, None, None, None, h,
         sm_scale, causal, block_q, block_k, interpret,
     )
 
 
 _flash_flat.defvjp(_flash_flat_fwd, _flash_flat_bwd)
+
+
+# (out, lse) variant with optional explicit position ids — the building
+# block for projection-layout ring attention (ops/ring_attention.py's
+# flat path). lse is differentiable (its cotangent folds into delta).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_flat_lse(
+    qf, kf, vf, row_ids, col_ids, h, sm_scale, causal, block_q, block_k,
+    interpret,
+):
+    return _flash_flat_fwd_impl(
+        qf, kf, vf, row_ids, col_ids, h, sm_scale, causal,
+        block_q, block_k, interpret,
+    )
+
+
+def _flash_flat_lse_fwd(
+    qf, kf, vf, row_ids, col_ids, h, sm_scale, causal, block_q, block_k,
+    interpret,
+):
+    out, lse = _flash_flat_fwd_impl(
+        qf, kf, vf, row_ids, col_ids, h, sm_scale, causal,
+        block_q, block_k, interpret,
+    )
+    out, lse = _name_attn_residuals(out, lse)
+    return (out, lse), (qf, kf, vf, row_ids, col_ids, out, lse)
+
+
+def _flash_flat_lse_bwd(h, sm_scale, causal, block_q, block_k, interpret,
+                        res, cts):
+    qf, kf, vf, row_ids, col_ids, out, lse = res
+    do, dlse = cts
+    dq, dk, dv = _flash_flat_bwd_impl(
+        qf, kf, vf, out, lse, do, dlse, row_ids, col_ids, h,
+        sm_scale, causal, block_q, block_k, interpret,
+    )
+    zero_ids = lambda ids: (
+        None if ids is None else np.zeros(ids.shape, jax.dtypes.float0)
+    )
+    return dq, dk, dv, zero_ids(row_ids), zero_ids(col_ids)
+
+
+_flash_flat_lse.defvjp(_flash_flat_lse_fwd, _flash_flat_lse_bwd)
+
+
+def flash_attention_bshd_lse(
+    q, k, v,
+    *,
+    row_ids=None,
+    col_ids=None,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Projection-layout flash attention returning ``(out, lse)`` —
+    :func:`flash_attention_lse`'s flat twin (q [B, Sq, H, D]; k, v
+    [B, Sk, Hkv, D] → out [B, Sq, H, D], lse [B, Sq, H]). The ring's
+    per-hop partials build on it; ``row_ids``/``col_ids`` switch to
+    ``col_id <= row_id`` masking over arbitrary position labelings
+    (ring hops, zigzag layouts)."""
+    if q.ndim != 4:
+        raise ValueError(f"expected [B, S, H, D] inputs, got rank {q.ndim}")
+    if (row_ids is None) != (col_ids is None):
+        raise ValueError("row_ids and col_ids must be given together")
+    b, q_len, h, d = q.shape
+    kv_len, h_kv = k.shape[1], k.shape[2]
+    if row_ids is not None:
+        if row_ids.shape != (q_len,):
+            raise ValueError(
+                f"row_ids shape {row_ids.shape} != (q_len,) = ({q_len},)"
+            )
+        if col_ids.shape != (kv_len,):
+            raise ValueError(
+                f"col_ids shape {col_ids.shape} != (kv_len,) = ({kv_len},)"
+            )
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    if h > 128:
+        raise ValueError(
+            f"flash_attention_bshd lane-packs per-head stats (<=128 "
+            f"heads); got {h} — use flash_attention for wider models"
+        )
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+    block_q = min(block_q, max(q_len, 1))
+    block_k = min(block_k, max(kv_len, 1))
+    out, lse = _flash_flat_lse(
+        q.reshape(b, q_len, h * d),
+        k.reshape(b, kv_len, h_kv * d),
+        v.reshape(b, kv_len, h_kv * d),
+        row_ids, col_ids, h, sm_scale, causal, block_q, block_k, interpret,
+    )
+    return out.reshape(b, q_len, h, d), lse
 
 
 def flash_attention_bshd(

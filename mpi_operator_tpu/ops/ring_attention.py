@@ -228,6 +228,126 @@ def ring_spec(mesh, axis: str = SP, n_heads: Optional[int] = None):
     return P(batch_axes if batch_axes else None, head_axis, axis, None)
 
 
+def bshd_spec(mesh, axis: str = SP, n_heads: Optional[int] = None):
+    """PartitionSpec for [B, S, H, D] projection-layout operands: batch
+    over dp×fsdp, sequence over the sp axis, heads over tp when the
+    head count divides it — ``ring_spec``'s twin for the flat layout."""
+    names = mesh.axis_names
+    batch_axes = tuple(a for a in (DP, FSDP) if a in names)
+    head_axis = None
+    if n_heads is not None and TP in names:
+        tp_size = dict(zip(names, mesh.devices.shape))[TP]
+        if tp_size > 1 and n_heads % tp_size == 0:
+            head_axis = TP
+    return P(batch_axes if batch_axes else None, axis, head_axis, None)
+
+
+def bshd_sp_specs(mesh, q_heads: int, kv_heads: int, axis: str = SP):
+    """(q_spec, kv_spec) for projection-layout sequence-parallel
+    operands (``sp_attention_specs``'s twin): heads ride tp only when
+    tp divides BOTH head counts."""
+    tp_ok = (
+        bshd_spec(mesh, axis, q_heads)[2] == TP
+        and bshd_spec(mesh, axis, kv_heads)[2] == TP
+    )
+    q_spec = bshd_spec(mesh, axis, q_heads if tp_ok else None)
+    kv_spec = bshd_spec(mesh, axis, kv_heads if tp_ok else None)
+    return q_spec, kv_spec
+
+
+def ring_attention_bshd(
+    q, k, v,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    zigzag: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    """Per-shard ring attention over the PROJECTION layout — the
+    sequence-parallel twin of ``attention.flash_attention_bshd``.
+
+    q: [B, S_local, H, D]; k, v: [B, S_local, H_kv, D], sequence-sharded
+    over ``axis_name`` (contiguous, or zigzag chunk pairs). Identical
+    ring/merge structure to :func:`ring_attention`, but every per-hop
+    partial is the flat kernel and the merge runs on [B, S, H]-shaped
+    lse — zero layout changes anywhere on the path."""
+    from .attention import flash_attention_bshd_lse
+
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    b, s_loc, h, d = q.shape
+    if h % k.shape[2]:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {k.shape[2]}")
+    if zigzag and s_loc % 2:
+        raise ValueError(f"zigzag needs an even local seq, got {s_loc}")
+
+    row = _shard_ids(my, n, s_loc, zigzag)
+
+    def step(carry, t):
+        o, lse, k_cur, v_cur = carry
+        src = jax.lax.rem(my - t + n, n)
+        col = _shard_ids(src, n, s_loc, zigzag)
+        o_t, lse_t = flash_attention_bshd_lse(
+            q, k_cur, v_cur,
+            row_ids=row if causal else None,
+            col_ids=col if causal else None,
+            sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+        )
+        o_t = o_t.astype(jnp.float32)
+        lse_new = jnp.logaddexp(lse, lse_t)
+        o_new = (
+            o * jnp.exp(lse - lse_new)[..., None]
+            + o_t * jnp.exp(lse_t - lse_new)[..., None]
+        )
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, lse_new, k_nxt, v_nxt), None
+
+    init = (
+        jnp.zeros_like(q, dtype=jnp.float32),
+        jnp.full_like(q[..., 0], NEG_INF, dtype=jnp.float32),
+        k,
+        v,
+    )
+    (o, _, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
+    return o.astype(q.dtype)
+
+
+def ring_attention_bshd_shard_mapped(
+    q, k, v,
+    mesh,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    axis: str = SP,
+    zigzag: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    """shard_map of the projection-layout ring — what the models'
+    ``attention_impl='ring'`` now calls directly on the raw
+    [B, S, H, D] projections (no transposes before or after)."""
+    from jax import shard_map
+
+    q_spec, kv_spec = bshd_sp_specs(mesh, q.shape[2], k.shape[2], axis)
+    fn = shard_map(
+        lambda a, b, c: ring_attention_bshd(
+            a, b, c, axis, causal=causal, sm_scale=sm_scale, zigzag=zigzag,
+            block_q=block_q, block_k=block_k,
+        ),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+        check_vma=False,  # same vma workaround as the bhsd variant below
+    )
+    return fn(q, k, v)
+
+
 def sp_attention(
     q, k, v,
     mesh,

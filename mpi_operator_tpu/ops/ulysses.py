@@ -40,15 +40,17 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from jax.sharding import PartitionSpec as P
-
-from ..parallel.mesh import DP, FSDP, SP, TP
+from ..parallel.mesh import SP
 from .attention import (
     attention_reference,
     flash_attention,
     flash_attention_bshd,
 )
-from .ring_attention import ring_spec, sp_attention_specs
+from .ring_attention import (
+    bshd_sp_specs,
+    ring_spec,
+    sp_attention_specs,
+)
 
 
 def _replicate_kv_for(h_kv: int, n: int):
@@ -162,33 +164,6 @@ def ulysses_attention_shard_mapped(
         check_vma=False,
     )
     return fn(q, k, v)
-
-
-def bshd_spec(mesh, axis: str = SP, n_heads: Optional[int] = None):
-    """PartitionSpec for [B, S, H, D] projection-layout operands: batch
-    over dp×fsdp, sequence over the sp axis, heads over tp when the
-    head count divides it — ``ring_spec``'s twin for the flat layout."""
-    names = mesh.axis_names
-    batch_axes = tuple(a for a in (DP, FSDP) if a in names)
-    head_axis = None
-    if n_heads is not None and TP in names:
-        tp_size = dict(zip(names, mesh.devices.shape))[TP]
-        if tp_size > 1 and n_heads % tp_size == 0:
-            head_axis = TP
-    return P(batch_axes if batch_axes else None, axis, head_axis, None)
-
-
-def bshd_sp_specs(mesh, q_heads: int, kv_heads: int, axis: str = SP):
-    """(q_spec, kv_spec) for projection-layout sequence-parallel
-    operands (``sp_attention_specs``'s twin): heads ride tp only when
-    tp divides BOTH head counts."""
-    tp_ok = (
-        bshd_spec(mesh, axis, q_heads)[2] == TP
-        and bshd_spec(mesh, axis, kv_heads)[2] == TP
-    )
-    q_spec = bshd_spec(mesh, axis, q_heads if tp_ok else None)
-    kv_spec = bshd_spec(mesh, axis, kv_heads if tp_ok else None)
-    return q_spec, kv_spec
 
 
 def ulysses_attention_bshd(

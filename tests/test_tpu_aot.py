@@ -133,6 +133,37 @@ class TestMosaicLowering:
         """, timeout=600)
 
     @pytest.mark.e2e
+    def test_flash_bshd_lse_ids_compile(self):
+        """The id-masked flat lse variant (ring attention's per-hop
+        kernel) adds (1, block) id operands and data-dependent masking
+        to the flat kernels — its Mosaic lowering is a distinct risk
+        from the static-mask path."""
+        _aot("""
+            import importlib
+            import mpi_operator_tpu.ops.attention as att
+            importlib.reload(att)
+
+            b, s, h, hkv, d = 1, 2048, 16, 8, 128
+            q = sds((b, s, h, d), jnp.bfloat16)
+            kv = sds((b, s, hkv, d), jnp.bfloat16)
+            row = sds((s,), jnp.int32)
+            col = sds((s,), jnp.int32)
+
+            def loss(q, k, v, row, col):
+                out, lse = att.flash_attention_bshd_lse(
+                    q, k, v, row_ids=row, col_ids=col
+                )
+                return jnp.sum(out ** 2) + jnp.sum(
+                    jnp.where(jnp.isfinite(lse), lse, 0.0)
+                )
+
+            jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(
+                q, kv, kv, row, col
+            ).compile()
+            print("AOT_OK")
+        """, timeout=600)
+
+    @pytest.mark.e2e
     def test_bn_kernels_compile(self):
         _aot("""
             import importlib
