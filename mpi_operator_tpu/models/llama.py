@@ -286,11 +286,15 @@ class Attention(nn.Module):
                 )
             out = out.reshape(b, s, cfg.n_heads * hd)
             return dense(cfg.dim, "wo")(out)
-
         # [B, H, S, D] layout. flash-bhsd (the transpose-convention
-        # kernel, kept as the hardware A/B) /ring/ulysses take
-        # GQA-shaped kv natively; the shared dispatch expands kv only
-        # for the dense oracle. Unknown impl names raise there.
+        # kernel, kept as the hardware A/B), the dense oracle, and the
+        # pipeline's manual-region '-shard' impls. (A projection-layout
+        # reroute of ring-shard was tried and reverted: its GRADIENT
+        # aborts the XLA CPU runtime inside the pp×sp×tp nested manual
+        # region — llama_pp's test_sp_tp_pp_gradients_match_plain —
+        # while the shard_mapped flat ring/ulysses paths above are
+        # green. Multi-chip-only path, so the transpose cost stays
+        # until that interaction is root-caused.)
         from ..ops.ring_attention import sp_attention
 
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
