@@ -43,6 +43,29 @@ BF16_PEAK_TFLOPS = {
     "v4": 275.0,
 }
 V5E_BF16_PEAK_TFLOPS = 197.0
+# Peak HBM bandwidth (GB/s) by device_kind substring — same matching
+# rules as BF16_PEAK_TFLOPS; v5e fallback.
+HBM_GBS = {
+    "v5 lite": 819.0,   # v5e
+    "v5e": 819.0,
+    "v5p": 2765.0,
+    "v5": 2765.0,
+    "v6 lite": 1640.0,  # v6e / Trillium
+    "v6e": 1640.0,
+    "v4": 1228.0,
+}
+V5E_HBM_GBS = 819.0
+
+
+def peak_hbm_gbs() -> tuple[float, str]:
+    """(peak HBM GB/s, label) for the first visible device."""
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for key, bw in HBM_GBS.items():
+        if key in kind.lower():
+            return bw, kind
+    return V5E_HBM_GBS, f"{kind} (assumed v5e bandwidth)"
 
 
 def peak_tflops() -> tuple[float, str]:
@@ -488,6 +511,93 @@ def bench_llama(args) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Decode (serving-side throughput; static-KV-cache autoregressive path)
+# ---------------------------------------------------------------------------
+
+
+def bench_decode(args) -> dict:
+    """Greedy decode throughput on the 0.7B llama with the static KV
+    cache (models/generate.py). Decode is HBM-bandwidth-bound — every
+    token re-reads the weights — so vs_baseline reports MBU (model-
+    bandwidth utilization): tokens/s x bf16 param bytes / peak HBM BW.
+    The reference publishes no inference numbers at all."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_operator_tpu.models import llama as llama_lib
+    from mpi_operator_tpu.models.generate import generate
+
+    n = len(jax.devices())
+    if args.decode_tiny:  # CPU test escape hatch: full path, toy widths
+        cfg = llama_lib.tiny(remat=False)
+    else:
+        cfg = llama_lib.llama3_8b(
+            vocab_size=32768, dim=2048, n_layers=12, n_heads=16,
+            n_kv_heads=8, ffn_dim=6144,
+            max_seq_len=args.decode_prompt + args.decode_new + 1,
+            remat=False,
+        )
+    model = llama_lib.Llama(cfg)
+    params = llama_lib.init_params(
+        model, jax.random.PRNGKey(0), batch=1, seq=16
+    )
+    n_params = _param_count(params)
+    # Serving practice: weights live in bf16 (halves the per-token read;
+    # the compute dtype is bf16 anyway).
+    params = jax.tree_util.tree_map(
+        lambda x: (x.astype(jnp.bfloat16)
+                   if x.dtype == jnp.float32 else x),
+        params,
+    )
+    batch = args.decode_batch * n
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (batch, args.decode_prompt)
+        ),
+        jnp.int32,
+    )
+    n2 = args.decode_new
+    n1 = max(n2 // 4, 1)
+    run = functools.partial(generate, params, prompt, cfg)
+
+    def sync(toks):
+        np.asarray(toks[:, -1:])  # host readback barrier (see _sync)
+
+    log(f"compiling decode (batch {batch}, {n_params / 1e6:.0f}M params, "
+        f"prompt {args.decode_prompt}, max_new {n1}/{n2})...")
+    sync(run(max_new=n1))  # compile both scan lengths outside the window
+    sync(run(max_new=n2))
+    # Both runs pay the same prefill (the scan covers prompt + new); the
+    # difference quotient isolates seconds per generated token and
+    # cancels the tunnel's fixed completion-latency quantum.
+    t0 = time.perf_counter()
+    sync(run(max_new=n1))
+    t1 = time.perf_counter()
+    sync(run(max_new=n2))
+    t2 = time.perf_counter()
+    sec_tok = ((t2 - t1) - (t1 - t0)) / (n2 - n1)
+    if sec_tok <= 0:  # noise floor
+        sec_tok = (t2 - t1) / (args.decode_prompt + n2)
+    tokens_per_sec = batch / sec_tok / n
+    hbm_gbs, kind = peak_hbm_gbs()
+    mbu = tokens_per_sec * 2 * n_params / (hbm_gbs * 1e9)
+    log(
+        f"decode: {tokens_per_sec:.0f} tok/s/chip at batch "
+        f"{args.decode_batch}/chip, {sec_tok * 1e3:.2f} ms/token-step, "
+        f"~{100 * mbu:.1f}% MBU ({kind}, bf16 weights)"
+    )
+    return {
+        "metric": "llama_0p7b_decode_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": f"tokens/sec/chip (batch {args.decode_batch})",
+        "vs_baseline": round(mbu, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Startup-to-first-step (the second primary metric in BASELINE.md)
 # ---------------------------------------------------------------------------
 
@@ -694,6 +804,7 @@ SUITES = {
     "resnet": bench_resnet,
     "bert": bench_bert,
     "llama": bench_llama,
+    "decode": bench_decode,
     "startup": bench_startup,
     "operator-scale": bench_operator_scale,
 }
@@ -864,6 +975,17 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--scale-jobs", type=int, default=200,
                         help="operator-scale suite: size of the TPUJob "
                              "creation storm")
+    parser.add_argument("--decode-batch", type=int, default=8,
+                        help="decode suite: sequences decoded in "
+                             "parallel per chip")
+    parser.add_argument("--decode-prompt", type=int, default=64,
+                        help="decode suite: prompt length")
+    parser.add_argument("--decode-new", type=int, default=256,
+                        help="decode suite: generated tokens in the "
+                             "long timing window (short window = 1/4)")
+    parser.add_argument("--decode-tiny", action="store_true",
+                        help="decode suite: toy-width config (CPU test "
+                             "escape hatch; numbers are meaningless)")
     parser.add_argument("--probe-only", action="store_true",
                         help="probe the accelerator (child process with "
                              "deadman, BENCH_PROBE_BUDGET_S retry budget) "

@@ -52,6 +52,7 @@ run resnet101-s2d      --suite resnet --profile-dir /tmp/trace-resnet
 run bert-base          --suite bert --profile-dir /tmp/trace-bert
 run llama-0p7b         --suite llama --profile-dir /tmp/trace-llama
 run startup            --suite startup
+run decode             --suite decode
 # Kernel-vs-compiler A/Bs (each isolates one hypothesis from the
 # round-3 MFU gap analysis; see docs/round3-notes.md). The suites above
 # already run the flat [B,S,H·D] kernels (the round-4 default); the

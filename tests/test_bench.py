@@ -75,3 +75,18 @@ class TestOperatorScaleSuite:
         m = re.search(r"writes/job = ([\d.]+)", out.stderr)
         assert m, out.stderr[-500:]
         assert float(m.group(1)) <= 12.0, out.stderr[-500:]
+
+
+class TestDecodeSuite:
+    def test_tiny_decode_reports_contract(self):
+        """Full decode-suite path (compile two scan lengths, diff-
+        quotient, MBU readout) at toy widths on CPU."""
+        out = _run([
+            "--suite", "decode", "--decode-tiny", "--decode-batch", "2",
+            "--decode-prompt", "8", "--decode-new", "16",
+        ])
+        assert out.returncode == 0, out.stderr[-800:] or out.stdout[-800:]
+        line = json.loads(out.stdout.strip().splitlines()[-1])
+        assert line["metric"] == "llama_0p7b_decode_tokens_per_sec_per_chip"
+        assert line["value"] > 0
+        assert line["vs_baseline"] >= 0
