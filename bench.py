@@ -511,6 +511,77 @@ def bench_llama(args) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# ViT-B/16 (third transformer family: image workloads on the encoder)
+# ---------------------------------------------------------------------------
+
+
+def bench_vit(args) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpi_operator_tpu.models import vit as vit_lib
+    from mpi_operator_tpu.parallel import create_mesh, shard_batch
+
+    n = len(jax.devices())
+    mesh = create_mesh(dp=-1)
+    cfg = vit_lib.vit_base(
+        attention_impl=args.attention_impl
+        if args.attention_impl in ("flash", "dense") else "flash",
+        flash_block_q=args.flash_block_q, flash_block_k=args.flash_block_k,
+        remat=args.vit_remat,
+    )
+    model = vit_lib.ViT(cfg)
+    params = vit_lib.init_params(model, jax.random.PRNGKey(0))
+    n_params = _param_count(params)
+    optimizer = optax.adamw(1e-4)
+    opt_state = optimizer.init(params)
+    replicated = NamedSharding(mesh, P())
+    params = jax.device_put(params, replicated)
+    opt_state = jax.device_put(opt_state, replicated)
+
+    batch = args.vit_batch * n
+    images = shard_batch(
+        np.random.RandomState(0)
+        .standard_normal((batch, cfg.image_size, cfg.image_size, 3))
+        .astype(np.float32),
+        mesh,
+    ).astype(jnp.bfloat16)
+    labels = shard_batch(
+        np.random.RandomState(1).randint(0, cfg.num_classes, (batch,)), mesh
+    )
+    step = jax.jit(
+        vit_lib.make_train_step(model, optimizer), donate_argnums=(0, 1)
+    )
+    log(f"compiling vit-b/16 train step (batch {batch}, "
+        f"{n_params / 1e6:.0f}M params)...")
+    with mesh:
+        (_, _, loss), sec = _timed_steps_maybe_profiled(
+            lambda p, o, l_, im, lb: step(p, o, im, lb),
+            (params, opt_state, None), (images, labels),
+            args,
+        )
+
+    per_chip = batch / sec / n
+    tflops = 3 * vit_lib.flops_per_image(cfg) * per_chip / 1e12
+    peak, kind = peak_tflops()
+    log(
+        f"vit-b/16: {per_chip:.1f} images/sec/chip, {sec * 1000:.1f} "
+        f"ms/step, loss {float(loss):.3f}, ~{tflops:.1f} TFLOP/s/chip "
+        f"(~{100 * tflops / peak:.1f}% of {kind} bf16 peak)"
+    )
+    return {
+        "metric": "vit_b16_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        # No reference transformer baseline exists; report MFU fraction.
+        "vs_baseline": round(tflops / peak, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Decode (serving-side throughput; static-KV-cache autoregressive path)
 # ---------------------------------------------------------------------------
 
@@ -804,6 +875,7 @@ SUITES = {
     "resnet": bench_resnet,
     "bert": bench_bert,
     "llama": bench_llama,
+    "vit": bench_vit,
     "decode": bench_decode,
     "startup": bench_startup,
     "operator-scale": bench_operator_scale,
@@ -975,6 +1047,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--scale-jobs", type=int, default=200,
                         help="operator-scale suite: size of the TPUJob "
                              "creation storm")
+    parser.add_argument("--vit-batch", type=int, default=128,
+                        help="vit suite: per-chip batch")
+    parser.add_argument("--vit-remat", action="store_true",
+                        help="vit suite: per-layer checkpoint for "
+                             "large-batch sweeps")
     parser.add_argument("--decode-batch", type=int, default=8,
                         help="decode suite: sequences decoded in "
                              "parallel per chip")

@@ -51,6 +51,7 @@ run() {
 run resnet101-s2d      --suite resnet --profile-dir /tmp/trace-resnet
 run bert-base          --suite bert --profile-dir /tmp/trace-bert
 run llama-0p7b         --suite llama --profile-dir /tmp/trace-llama
+run vit-b16            --suite vit --profile-dir /tmp/trace-vit
 run startup            --suite startup
 run decode             --suite decode
 # Kernel-vs-compiler A/Bs (each isolates one hypothesis from the

@@ -1,0 +1,230 @@
+"""Vision Transformer (ViT) in Flax — the third transformer family.
+
+The reference operator ships no model code at all (its examples run user
+Horovod containers over tf_cnn_benchmarks CNNs —
+/root/reference/examples/v2beta1/tensorflow-benchmarks/,
+README.md:175-206); this framework's model zoo is first-class, and ViT
+closes the gap between its conv family (resnet.py) and its language
+families (bert.py, llama.py): image workloads on the transformer stack.
+
+TPU-first choices:
+
+- **patchify is a matmul, not a conv**: non-overlapping p×p patches are
+  a pure reshape ([B, H/p, p, W/p, p, C] → [B, N, p²·C]) followed by a
+  Dense — lands directly on the MXU with no conv lowering;
+- attention through the projection-layout flash kernel
+  (``ops.flash_attention_bshd`` — zero layout copies, see PERF.md) with
+  the same ``attention_impl`` dispatch surface as bert/llama;
+- pre-LN blocks (the ViT/AugReg convention), bf16 compute / f32 params,
+  f32 logits via the shared ``ops.losses.f32_logits`` idiom;
+- dp/fsdp/tp sharding rules in the same shape as the other families.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import FSDP, TP
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    # 'flash' (projection-layout pallas kernel) or 'dense' (XLA oracle).
+    attention_impl: str = "flash"
+    flash_block_q: int = 128
+    flash_block_k: int = 128
+    # Per-layer jax.checkpoint for large-batch sweeps.
+    remat: bool = False
+    remat_policy: str = "dots"
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+def vit_base(**overrides) -> ViTConfig:
+    """ViT-B/16 (86M params)."""
+    return dataclasses.replace(ViTConfig(), **overrides)
+
+
+def tiny(**overrides) -> ViTConfig:
+    base = ViTConfig(
+        image_size=32, patch_size=8, num_classes=16, dim=32, n_layers=2,
+        n_heads=2, ffn_dim=64, dtype=jnp.float32, attention_impl="dense",
+    )
+    return dataclasses.replace(base, **overrides)
+
+
+class EncoderBlock(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, s, _ = x.shape
+        hd = cfg.head_dim
+        dense = lambda feats, name: nn.Dense(
+            feats, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
+        )
+        ln = lambda name: nn.LayerNorm(
+            epsilon=cfg.norm_eps, dtype=cfg.dtype, name=name
+        )
+
+        h = ln("attn_norm")(x)
+        q = dense(cfg.dim, "wq")(h).reshape(b, s, cfg.n_heads, hd)
+        k = dense(cfg.dim, "wk")(h).reshape(b, s, cfg.n_heads, hd)
+        v = dense(cfg.dim, "wv")(h).reshape(b, s, cfg.n_heads, hd)
+        if cfg.attention_impl == "flash":
+            from ..ops.attention import flash_attention_bshd
+
+            att = flash_attention_bshd(
+                q, k, v, causal=False,
+                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+            )
+        elif cfg.attention_impl == "dense":
+            from ..ops.attention import attention_reference
+
+            att = attention_reference(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), causal=False,
+            ).transpose(0, 2, 1, 3)
+        else:
+            raise ValueError(
+                f"vit attention_impl must be 'flash' or 'dense', got "
+                f"{cfg.attention_impl!r}"
+            )
+        x = x + dense(cfg.dim, "wo")(att.reshape(b, s, cfg.dim))
+        h = ln("mlp_norm")(x)
+        h = nn.gelu(dense(cfg.ffn_dim, "ffn_in")(h))
+        return x + dense(cfg.dim, "ffn_out")(h)
+
+
+class ViT(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, images):
+        """images [B, H, W, C] → logits [B, num_classes] (f32)."""
+        cfg = self.config
+        b, hh, ww, c = images.shape
+        p = cfg.patch_size
+        if hh % p or ww % p:
+            raise ValueError(
+                f"image {hh}x{ww} not divisible by patch size {p}"
+            )
+        # Patchify as reshape + Dense: exact for non-overlapping patches
+        # and a single MXU matmul instead of a conv lowering.
+        patches = images.astype(cfg.dtype).reshape(
+            b, hh // p, p, ww // p, p, c
+        ).transpose(0, 1, 3, 2, 4, 5).reshape(b, -1, p * p * c)
+        x = nn.Dense(
+            cfg.dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="embed"
+        )(patches)
+
+        cls = self.param(
+            "cls", nn.initializers.zeros_init(), (1, 1, cfg.dim), jnp.float32
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(cfg.dtype), (b, 1, cfg.dim)), x],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, cfg.n_patches + 1, cfg.dim), jnp.float32,
+        )
+        x = x + pos.astype(cfg.dtype)
+
+        block = EncoderBlock
+        if cfg.remat:
+            from .llama import remat_policy_for
+
+            block = nn.remat(
+                EncoderBlock, static_argnums=(), policy=remat_policy_for(cfg)
+            )
+        for i in range(cfg.n_layers):
+            x = block(cfg, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(
+            epsilon=cfg.norm_eps, dtype=cfg.dtype, name="final_norm"
+        )(x)
+        # Classification from the CLS token; f32 logits for a stable CE
+        # with compute-dtype operands (ops/losses.py:f32_logits idiom).
+        from ..ops.losses import f32_logits
+
+        # Small-normal head (not the fine-tune-style zeros init): a zero
+        # head kills every upstream gradient on step one (d_x = g @ 0).
+        head = self.param(
+            "head", nn.initializers.normal(0.02),
+            (cfg.dim, cfg.num_classes), jnp.float32,
+        )
+        return f32_logits(x[:, 0], head)
+
+
+def init_params(model: ViT, rng, batch: int = 2):
+    cfg = model.config
+    images = jnp.zeros(
+        (batch, cfg.image_size, cfg.image_size, 3), jnp.float32
+    )
+    return model.init(rng, images)["params"]
+
+
+def loss_fn(model: ViT, params, images, labels):
+    logits = model.apply({"params": params}, images)
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    )
+
+
+def make_train_step(model: ViT, optimizer, accum_steps: int = 1):
+    from ..parallel.accum import make_update_step
+
+    return make_update_step(
+        lambda p, im, lb: loss_fn(model, p, im, lb), optimizer, accum_steps
+    )
+
+
+def flops_per_image(cfg: ViTConfig) -> float:
+    """Forward FLOPs per image (2×MAC convention, matmul params only —
+    the same accounting the bert/llama suites use). Patch embed + per-
+    layer qkv/o/ffn + attention's 4·N·d per token + head."""
+    n = cfg.n_patches + 1
+    per_token_params = (
+        cfg.patch_size ** 2 * 3 * cfg.dim          # embed (patch tokens)
+        + cfg.n_layers * (4 * cfg.dim ** 2 + 2 * cfg.dim * cfg.ffn_dim)
+    )
+    attn = cfg.n_layers * 4 * n * n * cfg.dim      # 2 matmuls × 2×MAC
+    return 2.0 * per_token_params * n + attn + 2.0 * cfg.dim * cfg.num_classes
+
+
+def param_sharding_rules(mesh):
+    """tp/fsdp rules in the family-standard shape (see llama.py)."""
+    from ..parallel.sharding import ends_with, mesh_axis
+
+    tp = mesh_axis(mesh, TP)
+    fsdp = mesh_axis(mesh, FSDP)
+    return [
+        (ends_with("wq/kernel", "wk/kernel", "wv/kernel", "ffn_in/kernel"),
+         P(fsdp, tp)),
+        (ends_with("wo/kernel", "ffn_out/kernel"), P(tp, fsdp)),
+        (ends_with("embed/kernel"), P(fsdp, tp)),
+        (ends_with("head",), P(fsdp, tp)),
+    ]
