@@ -53,8 +53,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="tpujob-train", description="SPMD trainer for TPUJob workloads"
     )
     p.add_argument("--model", default="resnet101",
-                   help="resnet18|resnet50|resnet101|bert-base|bert-tiny|"
-                        "llama3-8b|llama-tiny|mixtral-8x7b|llama-moe-tiny")
+                   help="resnet18|resnet50|resnet101|vit-base|vit-tiny|"
+                        "bert-base|bert-tiny|llama3-8b|llama-tiny|"
+                        "mixtral-8x7b|llama-moe-tiny")
     p.add_argument("--mesh", default="",
                    help="axis spec, e.g. dp=2,fsdp=4,tp=2 (axes: dp fsdp "
                         "ep tp sp pp; pp pipelines dense llama blocks via "
@@ -211,6 +212,56 @@ def _resnet_workload(args, mesh, n_devices: int) -> Workload:
 
     return Workload(
         state={"params": params, "batch_stats": batch_stats, "opt_state": opt_state},
+        step_fn=step_fn,
+        batch=(images, labels),
+        examples_per_step=global_batch,
+        mesh=mesh,
+    )
+
+
+def _vit_workload(args, mesh, n_devices: int) -> Workload:
+    import jax
+    import numpy as np
+    import optax
+
+    from ..models import vit as vit_lib
+    from ..parallel import shard_batch, shard_params
+
+    cfg = (vit_lib.tiny() if args.model == "vit-tiny"
+           else vit_lib.vit_base(remat=args.remat_policy == "full"))
+    global_batch = args.global_batch or 64 * n_devices
+    model = vit_lib.ViT(cfg)
+    params = vit_lib.init_params(model, jax.random.PRNGKey(args.seed))
+    optimizer = optax.adamw(_make_learning_rate(args))
+    opt_state = optimizer.init(params)
+    rules = vit_lib.param_sharding_rules(mesh)
+    params = shard_params(params, mesh, rules=rules)
+    opt_state = shard_params(opt_state, mesh, rules=rules)
+
+    rng = np.random.RandomState(args.seed)
+    images = shard_batch(
+        rng.standard_normal(
+            (global_batch, cfg.image_size, cfg.image_size, 3)
+        ).astype(np.float32),
+        mesh,
+    )
+    labels = shard_batch(
+        rng.randint(0, cfg.num_classes, (global_batch,)), mesh
+    )
+
+    raw_step = jax.jit(
+        vit_lib.make_train_step(model, optimizer, args.grad_accum),
+        donate_argnums=(0, 1),
+    )
+
+    def step_fn(state, batch):
+        params, opt_state, loss = raw_step(
+            state["params"], state["opt_state"], *batch
+        )
+        return {"params": params, "opt_state": opt_state}, loss
+
+    return Workload(
+        state={"params": params, "opt_state": opt_state},
         step_fn=step_fn,
         batch=(images, labels),
         examples_per_step=global_batch,
@@ -664,6 +715,8 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
 def build_workload(args, mesh, n_devices: int) -> Workload:
     if args.model.startswith("resnet"):
         return _resnet_workload(args, mesh, n_devices)
+    if args.model.startswith("vit"):
+        return _vit_workload(args, mesh, n_devices)
     if args.model.startswith(("bert", "llama", "mixtral")):
         return _lm_workload(args, mesh, n_devices)
     raise SystemExit(f"unknown --model {args.model!r}")
