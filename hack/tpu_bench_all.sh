@@ -82,5 +82,6 @@ python hack/bn_probe.py 1 && python hack/bn_probe.py 5 \
 echo "== sweeps (in-process; every point appended to TUNE_CAPTURE.jsonl) =="
 python hack/tpu_tune.py llama --profile-best /tmp/trace-llama-best
 python hack/tpu_tune.py bert
+python hack/tpu_tune.py vit
 
 echo "== done; commit $out, TUNE_CAPTURE.jsonl, and fold $md into PERF.md =="

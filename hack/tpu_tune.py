@@ -75,9 +75,25 @@ BERT_SWEEP = [
 ]
 
 
+VIT_SWEEP = [
+    ("base-b128", {"suite": "vit"}),
+    ("dense-attn", {"suite": "vit", "attention_impl": "dense"}),
+    ("fb256", {"suite": "vit", "flash_block_q": 256,
+               "flash_block_k": 256}),
+    ("b256-remat", {"suite": "vit", "vit_batch": 256, "vit_remat": True}),
+    ("b64", {"suite": "vit", "vit_batch": 64}),
+]
+
+_SWEEPS = {
+    "llama": LLAMA_SWEEP,
+    "bert": BERT_SWEEP,
+    "vit": VIT_SWEEP,
+}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("which", choices=["llama", "bert"])
+    ap.add_argument("which", choices=sorted(_SWEEPS))
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--out", default="TUNE_CAPTURE.jsonl")
     ap.add_argument("--profile-best", default="",
@@ -85,8 +101,12 @@ def main() -> int:
                          "this profile dir")
     args = ap.parse_args()
 
-    sweep = LLAMA_SWEEP if args.which == "llama" else BERT_SWEEP
-    fn = bench.bench_llama if args.which == "llama" else bench.bench_bert
+    sweep = _SWEEPS[args.which]
+    fn = {
+        "llama": bench.bench_llama,
+        "bert": bench.bench_bert,
+        "vit": bench.bench_vit,
+    }[args.which]
     if args.quick:
         sweep = sweep[:3]
 
