@@ -527,9 +527,15 @@ def bench_vit(args) -> dict:
 
     n = len(jax.devices())
     mesh = create_mesh(dp=-1)
+    if args.attention_impl not in ("flash", "dense"):
+        # A coerced A/B would record flat-kernel numbers under another
+        # label (the vit model has no bhsd variant) — refuse instead.
+        raise SystemExit(
+            f"vit suite supports --attention-impl flash|dense, got "
+            f"{args.attention_impl!r}"
+        )
     cfg = vit_lib.vit_base(
-        attention_impl=args.attention_impl
-        if args.attention_impl in ("flash", "dense") else "flash",
+        attention_impl=args.attention_impl,
         flash_block_q=args.flash_block_q, flash_block_k=args.flash_block_k,
         remat=args.vit_remat,
     )
@@ -631,7 +637,12 @@ def bench_decode(args) -> dict:
         jnp.int32,
     )
     n2 = args.decode_new
-    n1 = max(n2 // 4, 1)
+    if n2 < 4:
+        raise SystemExit(
+            "--decode-new must be >= 4 (the difference quotient needs "
+            "two distinct window lengths)"
+        )
+    n1 = n2 // 4
     run = functools.partial(generate, params, prompt, cfg)
 
     def sync(toks):

@@ -227,8 +227,11 @@ def _vit_workload(args, mesh, n_devices: int) -> Workload:
     from ..models import vit as vit_lib
     from ..parallel import shard_batch, shard_params
 
+    # remat stays config-default (off — ViT-B/16 activations fit at the
+    # CLI batch; bench.py's --vit-remat is the large-batch sweep knob);
+    # the policy threads through so remat configs honor the flag.
     cfg = (vit_lib.tiny() if args.model == "vit-tiny"
-           else vit_lib.vit_base(remat=args.remat_policy == "full"))
+           else vit_lib.vit_base(remat_policy=args.remat_policy))
     global_batch = args.global_batch or 64 * n_devices
     model = vit_lib.ViT(cfg)
     params = vit_lib.init_params(model, jax.random.PRNGKey(args.seed))

@@ -75,48 +75,19 @@ class EncoderLayer(nn.Module):
         q = dense(cfg.dim, "wq")(x).reshape(b, s, cfg.n_heads, hd)
         k = dense(cfg.dim, "wk")(x).reshape(b, s, cfg.n_heads, hd)
         v = dense(cfg.dim, "wv")(x).reshape(b, s, cfg.n_heads, hd)
-        if cfg.attention_impl == "flash":
-            # Projection-layout kernel ([B, S, H, D] straight from the
-            # Dense reshape): zero layout copies around the attention
-            # custom calls (see ops/attention.py:flash_attention_bshd).
-            from ..ops.attention import flash_attention_bshd
+        # Transpose-free dispatch first (flash + ring/ulysses twins on
+        # the raw projection layout; ops/ring_attention.py); impls that
+        # need the [B, H, S, D] convention (flash-bhsd A/B, dense
+        # oracle) fall through to the transposed path.
+        from ..ops.ring_attention import sp_attention, sp_attention_bshd
 
-            att = flash_attention_bshd(
-                q, k, v, causal=False,
-                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
-            ).reshape(b, s, cfg.dim)
-        elif cfg.attention_impl in ("ulysses", "ring"):
-            # Sequence-parallel twins of the flat path (transpose-free
-            # collectives; ops/ulysses.py, ops/ring_attention.py).
-            from ..parallel.mesh import SP
-
-            if self.mesh is None or SP not in self.mesh.axis_names:
-                raise ValueError(
-                    f"attention_impl={cfg.attention_impl!r} needs a mesh "
-                    f"with an sp axis"
-                )
-            if cfg.attention_impl == "ulysses":
-                from ..ops.ulysses import ulysses_attention_bshd_shard_mapped
-
-                att = ulysses_attention_bshd_shard_mapped(
-                    q, k, v, self.mesh, causal=False,
-                    block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
-                )
-            else:
-                from ..ops.ring_attention import (
-                    ring_attention_bshd_shard_mapped,
-                )
-
-                att = ring_attention_bshd_shard_mapped(
-                    q, k, v, self.mesh, causal=False,
-                    block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
-                )
+        att = sp_attention_bshd(
+            q, k, v, self.mesh, cfg.attention_impl, causal=False,
+            block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+        )
+        if att is not None:
             att = att.reshape(b, s, cfg.dim)
         else:
-            # [B, H, S, D] convention (flash-bhsd A/B, dense oracle,
-            # and the sequence-parallel strategies).
-            from ..ops.ring_attention import sp_attention
-
             q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
             att = sp_attention(
                 q, k, v, self.mesh, cfg.attention_impl, causal=False,

@@ -244,48 +244,19 @@ class Attention(nn.Module):
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
 
-        if cfg.attention_impl == "flash":
-            # Projection-layout kernel: q/k/v go in exactly as RoPE
-            # produced them ([B, S, H, D]) — the [B, H, S, D] convention
-            # forces XLA to materialize layout copies around the kernel
-            # on all four tensors, fwd and bwd, every layer (PERF.md:
-            # 12.5 GB/step on the BERT program).
-            from ..ops.attention import flash_attention_bshd
+        # Transpose-free dispatch first: flash (projection-layout
+        # kernel, zero layout copies — PERF.md) and the ring/ulysses
+        # sequence-parallel twins run on q/k/v exactly as RoPE produced
+        # them ([B, S, H, D]).
+        from ..ops.ring_attention import sp_attention, sp_attention_bshd
 
-            out = flash_attention_bshd(
-                q, k, v, causal=True,
-                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
-            ).reshape(b, s, cfg.n_heads * hd)
-            return dense(cfg.dim, "wo")(out)
-        if cfg.attention_impl in ("ulysses", "ring"):
-            # Sequence-parallel twins of the flat path: the collectives
-            # (all-to-alls / ppermute hops) move the projection layout
-            # directly, so long-context sp runs are also transpose-free
-            # end to end.
-            if self.mesh is None or SP not in self.mesh.axis_names:
-                raise ValueError(
-                    f"attention_impl={cfg.attention_impl!r} needs a mesh "
-                    f"with an sp axis"
-                )
-            if cfg.attention_impl == "ulysses":
-                from ..ops.ulysses import ulysses_attention_bshd_shard_mapped
-
-                out = ulysses_attention_bshd_shard_mapped(
-                    q, k, v, self.mesh, causal=True,
-                    block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
-                )
-            else:
-                from ..ops.ring_attention import (
-                    ring_attention_bshd_shard_mapped,
-                )
-
-                out = ring_attention_bshd_shard_mapped(
-                    q, k, v, self.mesh, causal=True,
-                    zigzag=_use_zigzag(cfg, self.mesh),
-                    block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
-                )
-            out = out.reshape(b, s, cfg.n_heads * hd)
-            return dense(cfg.dim, "wo")(out)
+        out = sp_attention_bshd(
+            q, k, v, self.mesh, cfg.attention_impl, causal=True,
+            zigzag=_use_zigzag(cfg, self.mesh),
+            block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+        )
+        if out is not None:
+            return dense(cfg.dim, "wo")(out.reshape(b, s, cfg.n_heads * hd))
         # [B, H, S, D] layout. flash-bhsd (the transpose-convention
         # kernel, kept as the hardware A/B), the dense oracle, and the
         # pipeline's manual-region '-shard' impls. (A projection-layout
@@ -295,7 +266,6 @@ class Attention(nn.Module):
         # while the shard_mapped flat ring/ulysses paths above are
         # green. Multi-chip-only path, so the transpose cost stays
         # until that interaction is root-caused.)
-        from ..ops.ring_attention import sp_attention
 
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
         out = sp_attention(

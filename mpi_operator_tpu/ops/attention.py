@@ -593,6 +593,7 @@ def _flash_lse_fwd(
     out, lse = _flash_fwd_impl(
         q, k, v, row_ids, col_ids, sm_scale, causal, block_q, block_k, interpret
     )
+    out, lse = _name_attn_residuals(out, lse)
     return (out, lse), (q, k, v, row_ids, col_ids, out, lse)
 
 

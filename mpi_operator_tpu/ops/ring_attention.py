@@ -348,6 +348,49 @@ def ring_attention_bshd_shard_mapped(
     return fn(q, k, v)
 
 
+def sp_attention_bshd(
+    q, k, v,
+    mesh,
+    impl: str,
+    *,
+    causal: bool,
+    zigzag: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    """Projection-layout twin of :func:`sp_attention` — the single
+    dispatch bert/llama call on the RAW [B, S, H, D] projections before
+    any transpose. Handles the transpose-free impls: 'flash' (flat
+    kernel), 'ring'/'ulysses' (sequence-parallel twins; need a mesh
+    with an sp axis). Returns ``None`` for impls that live on the
+    [B, H, S, D] path (dense oracle, flash-bhsd A/B, the pipeline's
+    '-shard' variants) — the caller then transposes and falls through
+    to :func:`sp_attention`, which raises on unknown names."""
+    from .attention import flash_attention_bshd
+
+    if impl == "flash":
+        return flash_attention_bshd(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k
+        )
+    if impl in ("ring", "ulysses"):
+        if mesh is None or SP not in mesh.axis_names:
+            raise ValueError(
+                f"attention_impl={impl!r} needs a mesh with an sp axis"
+            )
+        if impl == "ulysses":
+            from .ulysses import ulysses_attention_bshd_shard_mapped
+
+            return ulysses_attention_bshd_shard_mapped(
+                q, k, v, mesh, causal=causal,
+                block_q=block_q, block_k=block_k,
+            )
+        return ring_attention_bshd_shard_mapped(
+            q, k, v, mesh, causal=causal, zigzag=zigzag,
+            block_q=block_q, block_k=block_k,
+        )
+    return None
+
+
 def sp_attention(
     q, k, v,
     mesh,
