@@ -26,7 +26,6 @@ import dataclasses
 from typing import Any
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
