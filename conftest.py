@@ -39,7 +39,7 @@ _KERNEL_TIER = {
     "test_parallel", "test_pipeline", "test_models", "test_transformers",
     "test_moe", "test_llama_pp", "test_data", "test_train", "test_eval",
     "test_generate", "test_tune", "test_bench", "test_tpu_aot",
-    "test_vit", "test_properties",
+    "test_vit", "test_properties", "test_seq2seq",
 }
 
 

@@ -1,0 +1,241 @@
+"""Encoder-decoder (seq2seq) transformer in Flax — the fourth
+transformer family, completing the architecture classes in the zoo
+(conv: resnet; encoder: bert/vit; decoder: llama; sparse: moe;
+pipelined: llama_pp; here: encoder-decoder with cross-attention).
+
+The reference operator ships no model code (user containers own the
+math — SURVEY.md §2.4). TPU-first choices match the siblings:
+
+- all three attention kinds (encoder self, decoder causal self, decoder
+  cross) run the projection-layout flash kernels
+  (``ops.flash_attention_bshd`` — zero layout copies; cross-attention
+  exercises the kernels' Sq != Sk path that the ops tier pins);
+- pre-LN blocks, bf16 compute / f32 params, f32 logits through the
+  shared ``ops.losses.f32_logits`` idiom, learned absolute positions
+  (T5-style relative position buckets would need an additive-bias lane
+  in the kernels — not worth the fusion break);
+- teacher-forced training loss with shifted decoder inputs; the same
+  ``parallel.accum`` update-step wrapper as every other family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import FSDP, TP
+
+
+@dataclasses.dataclass(frozen=True)
+class Seq2SeqConfig:
+    vocab_size: int = 32128
+    dim: int = 512
+    n_enc_layers: int = 6
+    n_dec_layers: int = 6
+    n_heads: int = 8
+    ffn_dim: int = 2048
+    max_seq_len: int = 512
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "flash"  # 'flash' (flat kernels) | 'dense'
+    flash_block_q: int = 128
+    flash_block_k: int = 128
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+def t5_small_shape(**overrides) -> Seq2SeqConfig:
+    """t5-small-shaped config (~60M params; structure, not weights)."""
+    return dataclasses.replace(Seq2SeqConfig(), **overrides)
+
+
+def tiny(**overrides) -> Seq2SeqConfig:
+    base = Seq2SeqConfig(
+        vocab_size=128, dim=32, n_enc_layers=2, n_dec_layers=2, n_heads=2,
+        ffn_dim=64, max_seq_len=64, dtype=jnp.float32,
+        attention_impl="dense",
+    )
+    return dataclasses.replace(base, **overrides)
+
+
+def _attend(cfg, q, k, v, causal):
+    """Shared attention dispatch: flat flash or the dense oracle.
+    q [B, Sq, H, D]; k, v [B, Sk, H, D]."""
+    if cfg.attention_impl == "flash":
+        from ..ops.attention import flash_attention_bshd
+
+        return flash_attention_bshd(
+            q, k, v, causal=causal,
+            block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+        )
+    if cfg.attention_impl == "dense":
+        from ..ops.attention import attention_reference
+
+        T = lambda x: x.transpose(0, 2, 1, 3)
+        return T(attention_reference(T(q), T(k), T(v), causal=causal))
+    raise ValueError(
+        f"seq2seq attention_impl must be 'flash' or 'dense', got "
+        f"{cfg.attention_impl!r}"
+    )
+
+
+class _Attention(nn.Module):
+    """One attention sublayer (self or cross) in projection layout."""
+
+    config: Seq2SeqConfig
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, kv):
+        cfg = self.config
+        b, sq, _ = x.shape
+        sk = kv.shape[1]
+        hd = cfg.head_dim
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name=name,
+        )
+        q = dense(cfg.dim, "wq")(x).reshape(b, sq, cfg.n_heads, hd)
+        k = dense(cfg.dim, "wk")(kv).reshape(b, sk, cfg.n_heads, hd)
+        v = dense(cfg.dim, "wv")(kv).reshape(b, sk, cfg.n_heads, hd)
+        att = _attend(cfg, q, k, v, self.causal)
+        return dense(cfg.dim, "wo")(att.reshape(b, sq, cfg.dim))
+
+
+class _MLP(nn.Module):
+    config: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name=name,
+        )
+        return dense(cfg.dim, "ffn_out")(
+            nn.gelu(dense(cfg.ffn_dim, "ffn_in")(x))
+        )
+
+
+class _EncoderBlock(nn.Module):
+    config: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(
+            epsilon=cfg.norm_eps, dtype=cfg.dtype, name=name
+        )
+        h = ln("attn_norm")(x)
+        x = x + _Attention(cfg, causal=False, name="self_attn")(h, h)
+        x = x + _MLP(cfg, name="mlp")(ln("mlp_norm")(x))
+        return x
+
+
+class _DecoderBlock(nn.Module):
+    config: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(self, x, enc):
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(
+            epsilon=cfg.norm_eps, dtype=cfg.dtype, name=name
+        )
+        h = ln("self_norm")(x)
+        x = x + _Attention(cfg, causal=True, name="self_attn")(h, h)
+        h = ln("cross_norm")(x)
+        x = x + _Attention(cfg, causal=False, name="cross_attn")(h, enc)
+        x = x + _MLP(cfg, name="mlp")(ln("mlp_norm")(x))
+        return x
+
+
+class Seq2Seq(nn.Module):
+    config: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(self, src_tokens, dec_tokens):
+        """src_tokens [B, S_src], dec_tokens [B, S_dec] (teacher-forced
+        decoder inputs) → f32 logits [B, S_dec, V]."""
+        cfg = self.config
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="embed",  # shared enc/dec table
+        )
+        pos = nn.Embed(
+            cfg.max_seq_len, cfg.dim, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="pos_embed",
+        )
+
+        def with_pos(tokens):
+            b, s = tokens.shape
+            return embed(tokens) + pos(
+                jnp.broadcast_to(jnp.arange(s), (b, s))
+            )
+
+        enc = with_pos(src_tokens)
+        for i in range(cfg.n_enc_layers):
+            enc = _EncoderBlock(cfg, name=f"enc_{i}")(enc)
+        enc = nn.LayerNorm(
+            epsilon=cfg.norm_eps, dtype=cfg.dtype, name="enc_norm"
+        )(enc)
+
+        dec = with_pos(dec_tokens)
+        for i in range(cfg.n_dec_layers):
+            dec = _DecoderBlock(cfg, name=f"dec_{i}")(dec, enc)
+        dec = nn.LayerNorm(
+            epsilon=cfg.norm_eps, dtype=cfg.dtype, name="dec_norm"
+        )(dec)
+
+        # Tied head on the shared table, f32 logits (losses.f32_logits).
+        from ..ops.losses import f32_logits
+
+        return f32_logits(dec, embed.embedding.T)
+
+
+def init_params(model: Seq2Seq, rng, batch: int = 2, src: int = 16,
+                dec: int = 8):
+    src_t = jnp.zeros((batch, src), jnp.int32)
+    dec_t = jnp.zeros((batch, dec), jnp.int32)
+    return model.init(rng, src_t, dec_t)["params"]
+
+
+def loss_fn(model: Seq2Seq, params, src_tokens, targets,
+            bos_id: int = 0):
+    """Teacher-forced seq2seq CE: decoder inputs are the targets shifted
+    right behind ``bos_id``."""
+    dec_in = jnp.concatenate(
+        [jnp.full_like(targets[:, :1], bos_id), targets[:, :-1]], axis=1
+    )
+    logits = model.apply({"params": params}, src_tokens, dec_in)
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    )
+
+
+def make_train_step(model: Seq2Seq, optimizer, accum_steps: int = 1):
+    from ..parallel.accum import make_update_step
+
+    return make_update_step(
+        lambda p, s, t: loss_fn(model, p, s, t), optimizer, accum_steps
+    )
+
+
+def param_sharding_rules(mesh):
+    """tp/fsdp rules in the family-standard shape (see llama.py)."""
+    from ..parallel.sharding import ends_with, mesh_axis
+
+    tp = mesh_axis(mesh, TP)
+    fsdp = mesh_axis(mesh, FSDP)
+    return [
+        (ends_with("wq/kernel", "wk/kernel", "wv/kernel", "ffn_in/kernel"),
+         P(fsdp, tp)),
+        (ends_with("wo/kernel", "ffn_out/kernel"), P(tp, fsdp)),
+        (ends_with("embed/embedding"), P(tp, fsdp)),
+    ]
