@@ -1,0 +1,192 @@
+"""Autoregressive decoding for the seq2seq family.
+
+Same TPU-first discipline as the llama decoder (``models/generate.py``):
+static shapes (preallocated decoder KV cache written with
+``lax.dynamic_update_slice``), one ``lax.scan`` over steps, and the
+decode math re-implements the block forward functionally — equivalence
+against the training forward is pinned by test (teacher-forced decode
+logits must match ``Seq2Seq.__call__`` exactly).
+
+Encoder-decoder specifics:
+
+- the encoder runs ONCE as a full-sequence pass (identical math to the
+  training encoder, re-implemented functionally over the param tree);
+- each decoder layer's cross-attention K/V are precomputed from the
+  encoder output ONCE (they never change during decoding) — per step
+  only the q projection and the [B, 1, S_src] cross scores are new;
+- the decoder self-attention cache is the llama-style static cache.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .seq2seq import Seq2SeqConfig
+
+
+def _ln(x, p, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+    norm = (xf - mu) * jax.lax.rsqrt(var + eps)
+    out = norm * p["scale"] + p["bias"]
+    return out.astype(x.dtype)
+
+
+def _proj(p, name, x, cfg):
+    return x @ p[name]["kernel"].astype(cfg.dtype)
+
+
+def _mlp(p, x, cfg):
+    h = jax.nn.gelu(_proj(p, "ffn_in", x, cfg))
+    return _proj(p, "ffn_out", h, cfg)
+
+
+def _full_self_attention(p, x, cfg, causal):
+    """Full-sequence attention for the one-shot encoder pass.
+    x: [B, S, D_model]."""
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+    from ..ops.attention import attention_reference
+
+    q = _proj(p, "wq", x, cfg).reshape(b, s, cfg.n_heads, hd)
+    k = _proj(p, "wk", x, cfg).reshape(b, s, cfg.n_heads, hd)
+    v = _proj(p, "wv", x, cfg).reshape(b, s, cfg.n_heads, hd)
+    T = lambda t: t.transpose(0, 2, 1, 3)
+    att = T(attention_reference(T(q), T(k), T(v), causal=causal))
+    return _proj(p, "wo", att.reshape(b, s, cfg.dim), cfg)
+
+
+def encode(params, cfg: Seq2SeqConfig, src_tokens):
+    """The training encoder, functionally: [B, S_src] → [B, S_src, D]."""
+    b, s = src_tokens.shape
+    embed = params["embed"]["embedding"]
+    pos = params["pos_embed"]["embedding"]
+    x = (embed[src_tokens] + pos[jnp.arange(s)][None]).astype(cfg.dtype)
+    for i in range(cfg.n_enc_layers):
+        p = params[f"enc_{i}"]
+        h = _ln(x, p["attn_norm"], cfg.norm_eps)
+        x = x + _full_self_attention(p["self_attn"], h, cfg, causal=False)
+        x = x + _mlp(p["mlp"], _ln(x, p["mlp_norm"], cfg.norm_eps), cfg)
+    return _ln(x, params["enc_norm"], cfg.norm_eps)
+
+
+def init_caches(params, cfg: Seq2SeqConfig, enc, batch: int, max_len: int):
+    """(self-attn caches, precomputed cross K/V) for every decoder
+    layer. Cross K/V never change during decoding — computed once."""
+    hd = cfg.head_dim
+    s_src = enc.shape[1]
+    self_caches, cross_kvs = [], []
+    for i in range(cfg.n_dec_layers):
+        p = params[f"dec_{i}"]
+        self_caches.append((
+            jnp.zeros((batch, max_len, cfg.n_heads, hd), cfg.dtype),
+            jnp.zeros((batch, max_len, cfg.n_heads, hd), cfg.dtype),
+        ))
+        ck = _proj(p["cross_attn"], "wk", enc, cfg).reshape(
+            batch, s_src, cfg.n_heads, hd
+        )
+        cv = _proj(p["cross_attn"], "wv", enc, cfg).reshape(
+            batch, s_src, cfg.n_heads, hd
+        )
+        cross_kvs.append((ck, cv))
+    return self_caches, cross_kvs
+
+
+def _attend_one(q, k, v, mask=None):
+    """One-position attention: q [B, H, Dh]; k, v [B, S, H, Dh]."""
+    s = jnp.einsum(
+        "bhd,bshd->bhs", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * (q.shape[-1] ** -0.5)
+    if mask is not None:
+        s = jnp.where(mask[:, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhs,bshd->bhd", p, v.astype(jnp.float32))
+
+
+def _decode_step(params, cfg: Seq2SeqConfig, self_caches, cross_kvs,
+                 token, pos):
+    """One decoder position against the caches. token [B]; pos scalar.
+    Returns (logits [B, V] f32, self_caches')."""
+    b = token.shape[0]
+    hd = cfg.head_dim
+    embed = params["embed"]["embedding"]
+    x = (embed[token] + params["pos_embed"]["embedding"][pos]).astype(
+        cfg.dtype
+    )
+    new_caches = []
+    for i in range(cfg.n_dec_layers):
+        p = params[f"dec_{i}"]
+        # Causal self-attention against the cache.
+        h = _ln(x, p["self_norm"], cfg.norm_eps)
+        q = _proj(p["self_attn"], "wq", h, cfg).reshape(b, cfg.n_heads, hd)
+        k = _proj(p["self_attn"], "wk", h, cfg).reshape(b, cfg.n_heads, hd)
+        v = _proj(p["self_attn"], "wv", h, cfg).reshape(b, cfg.n_heads, hd)
+        ck, cv = self_caches[i]
+        ck = jax.lax.dynamic_update_slice(ck, k[:, None], (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v[:, None], (0, pos, 0, 0))
+        new_caches.append((ck, cv))
+        visible = jnp.arange(ck.shape[1])[None, :] <= pos
+        att = _attend_one(q, ck, cv, jnp.broadcast_to(visible, (b, ck.shape[1])))
+        x = x + _proj(
+            p["self_attn"], "wo",
+            att.reshape(b, cfg.dim).astype(cfg.dtype), cfg,
+        )
+        # Cross-attention against the precomputed encoder K/V.
+        h = _ln(x, p["cross_norm"], cfg.norm_eps)
+        qc = _proj(p["cross_attn"], "wq", h, cfg).reshape(b, cfg.n_heads, hd)
+        ek, ev = cross_kvs[i]
+        catt = _attend_one(qc, ek, ev)
+        x = x + _proj(
+            p["cross_attn"], "wo",
+            catt.reshape(b, cfg.dim).astype(cfg.dtype), cfg,
+        )
+        x = x + _mlp(p["mlp"], _ln(x, p["mlp_norm"], cfg.norm_eps), cfg)
+    x = _ln(x, params["dec_norm"], cfg.norm_eps)
+    from ..ops.losses import f32_logits
+
+    return f32_logits(x, embed.T), new_caches
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new"))
+def generate(params, src_tokens, cfg: Seq2SeqConfig, max_new: int,
+             bos_id: int = 0):
+    """Greedy decode ``max_new`` tokens conditioned on ``src_tokens``
+    [B, S_src], starting from ``bos_id``. Returns [B, max_new]."""
+    b = src_tokens.shape[0]
+    enc = encode(params, cfg, src_tokens)
+    self_caches, cross_kvs = init_caches(params, cfg, enc, b, max_new)
+
+    def step(carry, t):
+        caches, token = carry
+        logits, caches = _decode_step(
+            params, cfg, caches, cross_kvs, token, t
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(src_tokens.dtype)
+        return (caches, nxt), nxt
+
+    init = (self_caches, jnp.full((b,), bos_id, src_tokens.dtype))
+    _, emitted = jax.lax.scan(step, init, jnp.arange(max_new))
+    return emitted.T  # [B, max_new]
+
+
+def decode_logits_teacher_forced(params, cfg: Seq2SeqConfig, src_tokens,
+                                 dec_tokens):
+    """Teacher-forced logits through the CACHED decode path — must equal
+    ``Seq2Seq.__call__(src, dec)`` exactly (the equivalence test)."""
+    b, s_dec = dec_tokens.shape
+    enc = encode(params, cfg, src_tokens)
+    self_caches, cross_kvs = init_caches(params, cfg, enc, b, s_dec)
+
+    def step(carry, t):
+        caches = carry
+        logits, caches = _decode_step(
+            params, cfg, caches, cross_kvs, dec_tokens[:, t], t
+        )
+        return caches, logits
+
+    _, logits = jax.lax.scan(step, self_caches, jnp.arange(s_dec))
+    return logits.transpose(1, 0, 2)  # [B, S_dec, V]
