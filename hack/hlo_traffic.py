@@ -156,12 +156,35 @@ def _build(suite: str, attention_impl: str, mesh):
         return step, tuple(sds(a) if not isinstance(a, jax.ShapeDtypeStruct)
                            else a for a in args)
 
+    if suite == "vit":
+        from mpi_operator_tpu.models import vit as vit_lib
+
+        cfg = vit_lib.vit_base(attention_impl=attention_impl)
+        model = vit_lib.ViT(cfg)
+        batch = 128
+        params = jax.eval_shape(
+            lambda: vit_lib.init_params(model, jax.random.PRNGKey(0))
+        )
+        optimizer = optax.adamw(1e-4)
+        opt_state = jax.eval_shape(optimizer.init, params)
+        step = vit_lib.make_train_step(model, optimizer)
+        args = (
+            params, opt_state,
+            jax.ShapeDtypeStruct(
+                (batch, cfg.image_size, cfg.image_size, 3), np.float32,
+                sharding=repl,
+            ),
+            jax.ShapeDtypeStruct((batch,), np.int32, sharding=repl),
+        )
+        return step, tuple(sds(a) if not isinstance(a, jax.ShapeDtypeStruct)
+                           else a for a in args)
+
     raise SystemExit(f"unknown suite {suite!r}")
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("suite", choices=["bert", "llama"])
+    ap.add_argument("suite", choices=["bert", "llama", "vit"])
     ap.add_argument("--attention-impl", default="flash",
                     choices=["flash", "flash-bhsd", "dense"])
     ap.add_argument("--dump", default="",
