@@ -164,3 +164,45 @@ class TestSeq2SeqDecode:
         np.testing.assert_array_equal(
             np.asarray(jnp.argmax(logits, axis=-1)), out
         )
+
+
+class TestNewFamilyCheckpoints:
+    """vit/seq2seq train states must round-trip the shared orbax
+    manager (utils/checkpoint) — the elastic restart path assumes every
+    family's state dict does."""
+
+    def _roundtrip(self, tmp_path, state):
+        from mpi_operator_tpu.utils.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path))
+        assert mgr.save(1, state, force=True)
+        mgr.wait_until_finished()  # orbax saves asynchronously
+        mgr.close()
+        mgr2 = CheckpointManager(str(tmp_path))
+        step, restored = mgr2.restore_latest(state)
+        assert step == 1
+        for a, b in zip(jax.tree_util.tree_leaves(state),
+                        jax.tree_util.tree_leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_seq2seq_state_roundtrips(self, tmp_path):
+        cfg = s2s.tiny()
+        model = s2s.Seq2Seq(cfg)
+        params = s2s.init_params(model, jax.random.PRNGKey(0))
+        optimizer = optax.adamw(1e-3)
+        self._roundtrip(
+            tmp_path / "s2s",
+            {"params": params, "opt_state": optimizer.init(params)},
+        )
+
+    def test_vit_state_roundtrips(self, tmp_path):
+        from mpi_operator_tpu.models import vit as vit_lib
+
+        cfg = vit_lib.tiny()
+        model = vit_lib.ViT(cfg)
+        params = vit_lib.init_params(model, jax.random.PRNGKey(0))
+        optimizer = optax.adamw(1e-3)
+        self._roundtrip(
+            tmp_path / "vit",
+            {"params": params, "opt_state": optimizer.init(params)},
+        )
