@@ -55,7 +55,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model", default="resnet101",
                    help="resnet18|resnet50|resnet101|vit-base|vit-tiny|"
                         "bert-base|bert-tiny|llama3-8b|llama-tiny|"
-                        "mixtral-8x7b|llama-moe-tiny")
+                        "mixtral-8x7b|llama-moe-tiny|seq2seq-small|"
+                        "seq2seq-tiny")
     p.add_argument("--mesh", default="",
                    help="axis spec, e.g. dp=2,fsdp=4,tp=2 (axes: dp fsdp "
                         "ep tp sp pp; pp pipelines dense llama blocks via "
@@ -267,6 +268,58 @@ def _vit_workload(args, mesh, n_devices: int) -> Workload:
         state={"params": params, "opt_state": opt_state},
         step_fn=step_fn,
         batch=(images, labels),
+        examples_per_step=global_batch,
+        mesh=mesh,
+    )
+
+
+def _seq2seq_workload(args, mesh, n_devices: int) -> Workload:
+    """Encoder-decoder on a synthetic copy task (targets = the source's
+    first half): cross-attention must learn to read the encoder, so the
+    loss curve is a real signal, not noise-fitting."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ..models import seq2seq as s2s
+    from ..parallel import shard_batch, shard_params
+
+    cfg = (s2s.tiny() if args.model == "seq2seq-tiny"
+           else s2s.t5_small_shape())
+    global_batch = args.global_batch or 16 * n_devices
+    src_len = min(args.seq_len or 64, cfg.max_seq_len)
+    dec_len = max(src_len // 2, 1)
+    model = s2s.Seq2Seq(cfg)
+    params = s2s.init_params(
+        model, jax.random.PRNGKey(args.seed), src=src_len, dec=dec_len
+    )
+    optimizer = optax.adamw(_make_learning_rate(args))
+    opt_state = optimizer.init(params)
+    rules = s2s.param_sharding_rules(mesh)
+    params = shard_params(params, mesh, rules=rules)
+    opt_state = shard_params(opt_state, mesh, rules=rules)
+
+    rng = np.random.RandomState(args.seed)
+    src = rng.randint(1, cfg.vocab_size, (global_batch, src_len))
+    src_s = shard_batch(jnp.asarray(src, jnp.int32), mesh)
+    tgt_s = shard_batch(jnp.asarray(src[:, :dec_len], jnp.int32), mesh)
+
+    raw_step = jax.jit(
+        s2s.make_train_step(model, optimizer, args.grad_accum),
+        donate_argnums=(0, 1),
+    )
+
+    def step_fn(state, batch):
+        params, opt_state, loss = raw_step(
+            state["params"], state["opt_state"], *batch
+        )
+        return {"params": params, "opt_state": opt_state}, loss
+
+    return Workload(
+        state={"params": params, "opt_state": opt_state},
+        step_fn=step_fn,
+        batch=(src_s, tgt_s),
         examples_per_step=global_batch,
         mesh=mesh,
     )
@@ -720,6 +773,8 @@ def build_workload(args, mesh, n_devices: int) -> Workload:
         return _resnet_workload(args, mesh, n_devices)
     if args.model.startswith("vit"):
         return _vit_workload(args, mesh, n_devices)
+    if args.model.startswith("seq2seq"):
+        return _seq2seq_workload(args, mesh, n_devices)
     if args.model.startswith(("bert", "llama", "mixtral")):
         return _lm_workload(args, mesh, n_devices)
     raise SystemExit(f"unknown --model {args.model!r}")
