@@ -13,26 +13,37 @@ from .attention import (
     attention_reference,
     flash_attention,
     flash_attention_bshd,
+    flash_attention_bshd_lse,
     flash_attention_lse,
 )
 from .ring_attention import (
     ring_attention,
+    ring_attention_bshd,
     ring_attention_sharded,
+    sp_attention_bshd,
     zigzag_indices,
     zigzag_inverse,
 )
 from .losses import lm_xent_chunked
-from .ulysses import ulysses_attention, ulysses_attention_sharded
+from .ulysses import (
+    ulysses_attention,
+    ulysses_attention_bshd,
+    ulysses_attention_sharded,
+)
 
 __all__ = [
     "attention_reference",
     "flash_attention",
     "flash_attention_bshd",
+    "flash_attention_bshd_lse",
     "flash_attention_lse",
     "lm_xent_chunked",
     "ring_attention",
+    "ring_attention_bshd",
     "ring_attention_sharded",
+    "sp_attention_bshd",
     "ulysses_attention",
+    "ulysses_attention_bshd",
     "ulysses_attention_sharded",
     "zigzag_indices",
     "zigzag_inverse",
