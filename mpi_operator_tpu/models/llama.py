@@ -259,13 +259,14 @@ class Attention(nn.Module):
             return dense(cfg.dim, "wo")(out.reshape(b, s, cfg.n_heads * hd))
         # [B, H, S, D] layout. flash-bhsd (the transpose-convention
         # kernel, kept as the hardware A/B), the dense oracle, and the
-        # pipeline's manual-region '-shard' impls. (A projection-layout
-        # reroute of ring-shard was tried and reverted: its GRADIENT
-        # aborts the XLA CPU runtime inside the pp×sp×tp nested manual
-        # region — llama_pp's test_sp_tp_pp_gradients_match_plain —
-        # while the shard_mapped flat ring/ulysses paths above are
-        # green. Multi-chip-only path, so the transpose cost stays
-        # until that interaction is root-caused.)
+        # pipeline's manual-region '-shard' impls. (Projection-layout
+        # reroutes of BOTH '-shard' impls were tried and reverted: the
+        # flat ring's gradient ABORTS the XLA CPU runtime inside the
+        # pp×sp×tp nested manual region, and the flat ulysses' gradient
+        # HANGS in the same nesting — while the shard_mapped flat
+        # ring/ulysses paths above are green. Multi-chip-only path, so
+        # the transpose cost stays until that interaction is
+        # root-caused.)
 
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
         out = sp_attention(
