@@ -62,7 +62,8 @@ _INSTR = re.compile(
     r"=\s+(\w+)\[([\d,]*)\][^ ]*\s+([\w-]+)\("
 )
 
-_MOVEMENT_OPS = ("copy", "copy-start", "copy-done", "transpose")
+_MOVEMENT_OPS = ("copy", "copy-start", "copy-done", "transpose",
+                 "bitcast-convert")
 
 
 def _census(hlo_text: str):

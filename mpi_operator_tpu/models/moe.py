@@ -119,10 +119,11 @@ class MoEMLP(nn.Module):
     # dtype of the combine weights in the output einsum. The compute
     # dtype (default) keeps both MXU operands bf16; f32 keeps the
     # combine exact at ~2x cost on that einsum (~5% of the MoE layer at
-    # mixtral shapes). Router GRADIENTS are equal either way up to bf16
-    # rounding — the combine weights' VALUES never enter d(combine)
-    # (bilinear einsum), so the cast only perturbs the forward like any
-    # other bf16 op; tests/test_moe.py pins that parity numerically.
+    # mixtral shapes). ROUTER-gradient parity holds either way up to
+    # bf16 rounding — the combine weights' VALUES never enter
+    # d(combine) = dy·h (bilinear einsum); tests/test_moe.py pins that
+    # numerically. The cast DOES perturb dh = combine^T·dy (expert and
+    # upstream gradients) along with the forward, like any bf16 op.
     combine_dtype: Optional[Any] = None
 
     @nn.compact
