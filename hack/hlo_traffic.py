@@ -91,7 +91,8 @@ def _census(hlo_text: str):
     return totals, biggest[:10]
 
 
-def _build(suite: str, attention_impl: str, mesh):
+def _build(suite: str, attention_impl: str, mesh, batch_override=None,
+           remat=False):
     """The bench.py-shaped train step + abstract args for one suite
     (same configs as bench.bench_bert / bench.bench_llama)."""
     import jax
@@ -160,9 +161,9 @@ def _build(suite: str, attention_impl: str, mesh):
     if suite == "vit":
         from mpi_operator_tpu.models import vit as vit_lib
 
-        cfg = vit_lib.vit_base(attention_impl=attention_impl)
+        cfg = vit_lib.vit_base(attention_impl=attention_impl, remat=remat)
         model = vit_lib.ViT(cfg)
-        batch = 128
+        batch = batch_override or 128
         params = jax.eval_shape(
             lambda: vit_lib.init_params(model, jax.random.PRNGKey(0))
         )
@@ -191,6 +192,10 @@ def main() -> int:
     ap.add_argument("--dump", default="",
                     help="write the compiled HLO text here for manual "
                          "inspection (hundreds of MB for the big suites)")
+    ap.add_argument("--batch", type=int, default=0,
+                    help="override the suite's default batch (vit sweeps)")
+    ap.add_argument("--remat", action="store_true",
+                    help="per-layer checkpoint (vit only today)")
     args = ap.parse_args()
 
     import numpy as np
@@ -206,9 +211,13 @@ def main() -> int:
     )
     mesh = Mesh(np.array(topo.devices[:1]).reshape(1), ("d",))
 
-    step, abstract_args = _build(args.suite, args.attention_impl, mesh)
-    print(f"compiling {args.suite} (attention={args.attention_impl}) "
-          f"for v5e...", flush=True)
+    step, abstract_args = _build(
+        args.suite, args.attention_impl, mesh,
+        batch_override=args.batch or None, remat=args.remat,
+    )
+    print(f"compiling {args.suite} (attention={args.attention_impl}"
+          f"{', batch ' + str(args.batch) if args.batch else ''}"
+          f"{', remat' if args.remat else ''}) for v5e...", flush=True)
     t0 = time.time()
     compiled = jax.jit(step, donate_argnums=(0, 1)).lower(
         *abstract_args

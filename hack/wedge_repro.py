@@ -1,0 +1,323 @@
+"""Bisection ladder for the flat-kernel gradient wedge (VERDICT r4 #2).
+
+Round-4 finding: rerouting the pipeline's in-manual-region attention
+('ring-shard' / 'ulysses-shard') onto the projection-layout flat kernels
+makes the GRADIENT abort the XLA:CPU runtime (flat ring) or hang (flat
+ulysses) inside the pp x sp x tp nested manual region, while the plain
+shard_mapped flat paths are green (models/llama.py Attention comment,
+docs/round4-notes.md). This script isolates which ingredient kills it.
+
+Each stage is a tiny differentiated program (B=1, S=16, H=2, D=8,
+block 8 — small enough that pallas interpret mode runs in seconds,
+which is what round 4's attempt got wrong) run in a SUBPROCESS with a
+timeout, so an abort or hang is classified instead of taking the
+driver down:
+
+    python hack/wedge_repro.py          # run the whole ladder, print table
+    python hack/wedge_repro.py STAGE    # run one stage inline (may crash!)
+
+Stages build up the nesting one ingredient at a time:
+
+    flat_sp            flat ring, shard_map manual over sp        (green)
+    bhsd_sp            [B,H,S,D] ring, same                       (control)
+    flat_sp_tp         + tp as a GSPMD AUTO axis (partial manual)
+    flat_sp_pp         + outer lax.scan with ppermute over pp (full manual)
+    flat_sp_pp_tp      + both (the pipeline's exact nesting)
+    bhsd_sp_pp_tp      control at full nesting
+    ulysses_sp_pp_tp   flat ulysses at full nesting
+    llama_pp_ring         the real llama_pp step (flat '-shard' with
+                          tp-manual kernel regions — the fix)
+    llama_pp_ulysses      same for ulysses
+    llama_pp_flat_raw_ring    NEGATIVE CONTROL: the round-4 reroute
+                          (direct flat kernels, no tp-manual wrap) —
+                          expected ABORT: the auto-axis partitioner
+                          splits the interpret-mode kernel's head
+                          slices over tp and plants halo
+                          collective-permutes inside device-varying
+                          pl.when branches; devices join different
+                          rendezvous and XLA:CPU CHECK-fails
+    llama_pp_flat_raw_ulysses same; INTERMITTENT — its kernel's causal
+                          clamp is uniform (block-index-based), so the
+                          failure needs the executor to order the
+                          GSPMD-inserted collectives differently
+                          across devices (round 4 observed a hang;
+                          some runs pass)
+
+ROOT CAUSE (found by this ladder + an HLO dump of the negative
+control): NOT the nesting itself — every synthetic stage is green.
+The round-4 wedge was GSPMD partitioning the pallas kernels'
+interpret-mode internals over the AUTO tp axis: the in-kernel head
+slices over the tp-sharded [H·D] dim become tiny halo
+collective-permutes INSIDE `pl.when` branches whose predicates are
+device-varying (id-masked causal clamps depend on axis_index(sp)), so
+devices disagree about which collective to run next and the runtime
+deadlocks. Fix: complete the kernel region to manual over tp
+(`ring_attention._flash_bshd_tp_manual`), which removes every
+auto-visible op from the kernel internals. On real TPU hardware the
+kernels are opaque Mosaic custom calls either way; interpret mode
+(chipless CI, the multichip dryrun) is where the partitioner could see
+inside.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+B, S, H, HKV, D = 1, 16, 2, 1, 8
+BLOCK = 8
+TIMEOUT_S = float(os.environ.get("WEDGE_TIMEOUT_S", "600"))
+
+
+def _env_cpu(n_devices: int) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    for var in ("TPU_LIBRARY_PATH", "PJRT_DEVICE", "TPU_NAME",
+                "PALLAS_AXON_POOL_IPS"):
+        env.pop(var, None)
+    return env
+
+
+# --------------------------------------------------------------------------
+# Stage bodies (run inline in the child process)
+# --------------------------------------------------------------------------
+
+
+def _setup(n_devices: int):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()[:n_devices]
+    assert len(devs) == n_devices, f"need {n_devices}, have {len(devs)}"
+    return jax, devs
+
+
+def _qkv(jnp):
+    import numpy as np
+
+    r = np.random.RandomState(0)
+    q = jnp.asarray(r.standard_normal((B, S, H, D)), jnp.float32)
+    k = jnp.asarray(r.standard_normal((B, S, HKV, D)), jnp.float32)
+    v = jnp.asarray(r.standard_normal((B, S, HKV, D)), jnp.float32)
+    return q, k, v
+
+
+def _ring_flat(q, k, v):
+    from mpi_operator_tpu.ops.ring_attention import ring_attention_bshd
+
+    return ring_attention_bshd(
+        q, k, v, "sp", causal=True, block_q=BLOCK, block_k=BLOCK
+    )
+
+
+def _ring_bhsd(q, k, v):
+    # [B,S,H,D] -> [B,H,S,D] per-shard, ring, back — what the pipeline
+    # runs today (the transposes the flat path exists to remove).
+    from mpi_operator_tpu.ops.ring_attention import ring_attention
+
+    qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    out = ring_attention(
+        qt, kt, vt, "sp", causal=True, block_q=BLOCK, block_k=BLOCK
+    )
+    return out.transpose(0, 2, 1, 3)
+
+
+def _ulysses_flat(q, k, v):
+    from mpi_operator_tpu.ops.ulysses import ulysses_attention_bshd
+
+    return ulysses_attention_bshd(
+        q, k, v, "sp", causal=True, block_q=BLOCK, block_k=BLOCK
+    )
+
+
+def _grad_stage(attn, manual_axes, mesh_axes, pp_scan: bool):
+    """Differentiate sum(attn-or-pipeline(q,k,v)) through shard_map."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    n = 1
+    for s in mesh_axes.values():
+        n *= s
+    jax_, devs = _setup(n)
+    import numpy as np
+
+    mesh = jax.sharding.Mesh(
+        np.asarray(devs).reshape(*mesh_axes.values()),
+        tuple(mesh_axes.keys()),
+    )
+
+    def per_shard(q, k, v):
+        if not pp_scan:
+            return attn(q, k, v)
+
+        def tick(state, t):
+            o = attn(state, k, v)
+            perm = [(i, (i + 1) % mesh_axes["pp"])
+                    for i in range(mesh_axes["pp"])]
+            return jax.lax.ppermute(o.astype(state.dtype), "pp", perm), None
+
+        state, _ = jax.lax.scan(tick, q, jnp.arange(3))
+        return state
+
+    spec = P(None, "sp", None, None)
+    kw = {}
+    if manual_axes is not None:
+        kw["axis_names"] = frozenset(manual_axes)
+    fn = jax.shard_map(
+        per_shard, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False, **kw,
+    )
+
+    def loss(q, k, v):
+        return jnp.sum(fn(q, k, v))
+
+    q, k, v = _qkv(jnp)
+    with mesh:
+        grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    jax.block_until_ready(grads)
+    gnorm = sum(float(jnp.sum(jnp.abs(g))) for g in grads)
+    assert gnorm > 0.0, "zero gradient"
+    print(f"grads ok, |g|_1 = {gnorm:.4f}")
+
+
+def _patch_flat_shard():
+    """NEGATIVE CONTROL: reroute the pipeline's in-manual-region impls
+    onto the RAW flat kernels with no tp-manual wrap — the exact
+    round-4 change that wedged (see module docstring for the root
+    cause this preserves a repro of)."""
+    # `mpi_operator_tpu.ops.__init__` re-exports the ring_attention
+    # FUNCTION, shadowing the submodule attribute on the package — go
+    # through sys.modules for the module object itself.
+    import importlib
+
+    ra = importlib.import_module("mpi_operator_tpu.ops.ring_attention")
+    orig = ra.sp_attention_bshd
+
+    def patched(q, k, v, mesh, impl, *, causal, zigzag=False,
+                block_q=128, block_k=128):
+        if impl == "ring-shard":
+            return ra.ring_attention_bshd(
+                q, k, v, ra.SP, causal=causal, zigzag=zigzag,
+                block_q=block_q, block_k=block_k,
+            )
+        if impl == "ulysses-shard":
+            from mpi_operator_tpu.ops.ulysses import ulysses_attention_bshd
+
+            return ulysses_attention_bshd(
+                q, k, v, ra.SP, causal=causal,
+                block_q=block_q, block_k=block_k,
+            )
+        return orig(q, k, v, mesh, impl, causal=causal, zigzag=zigzag,
+                    block_q=block_q, block_k=block_k)
+
+    ra.sp_attention_bshd = patched
+
+
+def _llama_pp_stage(impl: str, flat: bool):
+    """The dryrun's sp2 x tp2 x pp2 config (the one that wedged), tiny."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    _setup(8)
+    if flat:
+        _patch_flat_shard()
+    from mpi_operator_tpu.models import llama as llama_lib
+    from mpi_operator_tpu.models import llama_pp as pp_lib
+    from mpi_operator_tpu.parallel import create_mesh, shard_batch
+
+    devices = jax.devices()[:8]
+    mesh = create_mesh(dp=-1, sp=2, tp=2, pp=2, devices=devices)
+    cfg = llama_lib.tiny(n_layers=2, attention_impl=impl, dim=64)
+    params = pp_lib.shard_pp_params(
+        pp_lib.init_pp_params(cfg, 2, jax.random.PRNGKey(5)), mesh
+    )
+    opt = optax.sgd(1e-2)
+    opt_state = pp_lib.shard_pp_opt_state(opt.init(params), mesh)
+    tokens = shard_batch(
+        jnp.asarray(
+            np.random.RandomState(6).randint(0, cfg.vocab_size, (4, 16)),
+            jnp.int32,
+        ),
+        mesh, sequence_axis=1,
+    )
+    step = jax.jit(pp_lib.make_pp_train_step(cfg, mesh, opt, 1))
+    with mesh:
+        params2, _, loss = step(params, opt_state, tokens)
+    jax.block_until_ready(loss)
+    assert jnp.isfinite(loss), f"non-finite loss {loss}"
+    print(f"llama_pp {impl} flat={flat} loss={float(loss):.4f}")
+
+
+STAGES = {
+    "flat_sp": (2, lambda: _grad_stage(
+        _ring_flat, None, {"sp": 2}, pp_scan=False)),
+    "bhsd_sp": (2, lambda: _grad_stage(
+        _ring_bhsd, None, {"sp": 2}, pp_scan=False)),
+    "flat_sp_tp": (4, lambda: _grad_stage(
+        _ring_flat, {"sp"}, {"sp": 2, "tp": 2}, pp_scan=False)),
+    "flat_sp_pp": (4, lambda: _grad_stage(
+        _ring_flat, None, {"sp": 2, "pp": 2}, pp_scan=True)),
+    "flat_sp_pp_tp": (8, lambda: _grad_stage(
+        _ring_flat, {"sp", "pp"}, {"sp": 2, "pp": 2, "tp": 2},
+        pp_scan=True)),
+    "bhsd_sp_pp_tp": (8, lambda: _grad_stage(
+        _ring_bhsd, {"sp", "pp"}, {"sp": 2, "pp": 2, "tp": 2},
+        pp_scan=True)),
+    "ulysses_sp_pp_tp": (8, lambda: _grad_stage(
+        _ulysses_flat, {"sp", "pp"}, {"sp": 2, "pp": 2, "tp": 2},
+        pp_scan=True)),
+    "llama_pp_ring": (8, lambda: _llama_pp_stage("ring", flat=False)),
+    "llama_pp_ulysses": (8, lambda: _llama_pp_stage("ulysses", flat=False)),
+    "llama_pp_flat_raw_ring": (
+        8, lambda: _llama_pp_stage("ring", flat=True)),
+    "llama_pp_flat_raw_ulysses": (
+        8, lambda: _llama_pp_stage("ulysses", flat=True)),
+}
+
+
+def main() -> int:
+    if len(sys.argv) > 1:
+        name = sys.argv[1]
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        sys.path.insert(0, repo)
+        STAGES[name][1]()
+        return 0
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    print(f"{'stage':24} {'devices':>7} {'verdict':>8} {'secs':>6}  detail")
+    for name, (n, _) in STAGES.items():
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), name],
+                env=_env_cpu(n), cwd=repo, timeout=TIMEOUT_S,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+            dt = time.time() - t0
+            tail = (proc.stdout or "").strip().splitlines()
+            tail = tail[-1][:90] if tail else ""
+            if proc.returncode == 0:
+                verdict = "OK"
+            elif proc.returncode < 0:
+                verdict = f"ABORT({-proc.returncode})"
+            else:
+                verdict = f"FAIL({proc.returncode})"
+        except subprocess.TimeoutExpired:
+            dt = time.time() - t0
+            verdict, tail = "HANG", f"no exit in {TIMEOUT_S:.0f}s"
+        print(f"{name:24} {n:>7} {verdict:>8} {dt:>6.1f}  {tail}",
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

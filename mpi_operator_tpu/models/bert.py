@@ -219,7 +219,7 @@ def make_train_step_positions(model: Bert, optimizer, accum_steps: int = 1):
 
 def param_sharding_rules(mesh):
     """tp/fsdp rules for ``parallel.shard_params`` (see llama.py)."""
-    from ..parallel.sharding import ends_with, mesh_axis
+    from ..parallel.sharding import active_mesh_axis, ends_with, mesh_axis
 
     tp = mesh_axis(mesh, TP)
     fsdp = mesh_axis(mesh, FSDP)
@@ -228,6 +228,10 @@ def param_sharding_rules(mesh):
          P(fsdp, tp)),
         (ends_with("wo/kernel", "ffn_out/kernel"), P(tp, fsdp)),
         # Only the vocab-sized table is safe to split over tp; pos/type
-        # tables (512- and 2-row) stay on the fsdp heuristic.
-        (ends_with("tok_embed/embedding"), P(tp, fsdp)),
+        # tables (512- and 2-row) stay on the fsdp heuristic. Without a
+        # real (size>1) tp, fsdp goes on the vocab dim: a feature-dim
+        # shard forces a full remat of layer-0 dx in the backward
+        # scatter (llama.py).
+        (ends_with("tok_embed/embedding"),
+         P(tp, fsdp) if active_mesh_axis(mesh, TP) else P(fsdp, None)),
     ]

@@ -257,16 +257,15 @@ class Attention(nn.Module):
         )
         if out is not None:
             return dense(cfg.dim, "wo")(out.reshape(b, s, cfg.n_heads * hd))
-        # [B, H, S, D] layout. flash-bhsd (the transpose-convention
+        # [B, H, S, D] layout: flash-bhsd (the transpose-convention
         # kernel, kept as the hardware A/B), the dense oracle, and the
-        # pipeline's manual-region '-shard' impls. (Projection-layout
-        # reroutes of BOTH '-shard' impls were tried and reverted: the
-        # flat ring's gradient ABORTS the XLA CPU runtime inside the
-        # pp×sp×tp nested manual region, and the flat ulysses' gradient
-        # HANGS in the same nesting — while the shard_mapped flat
-        # ring/ulysses paths above are green. Multi-chip-only path, so
-        # the transpose cost stays until that interaction is
-        # root-caused.)
+        # pipeline's '-shard' impls ONLY when tp does not divide the
+        # head counts. (The round-4 wedge — flat '-shard' gradients
+        # aborting/hanging the XLA:CPU runtime in the pp×sp×tp nesting
+        # — was root-caused to the AUTO-axis partitioner reaching the
+        # interpret-mode kernel internals; the flat path now completes
+        # the kernel region to manual over tp and handles '-shard'
+        # above. hack/wedge_repro.py keeps the negative control.)
 
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
         out = sp_attention(
@@ -449,7 +448,7 @@ def param_sharding_rules(mesh):
     tp), embeddings split vocab over tp; the other matrix dim takes fsdp.
     Falls back gracefully when the mesh lacks a tp axis (pure FSDP).
     """
-    from ..parallel.sharding import ends_with, mesh_axis
+    from ..parallel.sharding import active_mesh_axis, ends_with, mesh_axis
 
     from . import moe as moe_lib
 
@@ -459,7 +458,14 @@ def param_sharding_rules(mesh):
         (ends_with("wq/kernel", "wk/kernel", "wv/kernel",
                    "w_gate/kernel", "w_up/kernel"), P(fsdp, tp)),
         (ends_with("wo/kernel", "w_down/kernel"), P(tp, fsdp)),
-        (ends_with("embed/embedding"), P(tp, fsdp)),
+        # The token table feeds a gather/scatter, not a matmul: without
+        # a REAL (size>1) tp axis, a feature-dim fsdp shard makes GSPMD
+        # fully rematerialize layer-0 dx to reach the scatter's layout
+        # (a per-step [B,S,D] all-gather + spmd_partitioner warning);
+        # splitting the vocab dim over fsdp partitions the scatter by
+        # row instead, no reshard.
+        (ends_with("embed/embedding"),
+         P(tp, fsdp) if active_mesh_axis(mesh, TP) else P(fsdp, None)),
         (ends_with("lm_head/kernel"), P(fsdp, tp)),
         (ends_with("scale",), P()),
     ]

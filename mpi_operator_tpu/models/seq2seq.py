@@ -229,7 +229,7 @@ def make_train_step(model: Seq2Seq, optimizer, accum_steps: int = 1):
 
 def param_sharding_rules(mesh):
     """tp/fsdp rules in the family-standard shape (see llama.py)."""
-    from ..parallel.sharding import ends_with, mesh_axis
+    from ..parallel.sharding import active_mesh_axis, ends_with, mesh_axis
 
     tp = mesh_axis(mesh, TP)
     fsdp = mesh_axis(mesh, FSDP)
@@ -237,5 +237,9 @@ def param_sharding_rules(mesh):
         (ends_with("wq/kernel", "wk/kernel", "wv/kernel", "ffn_in/kernel"),
          P(fsdp, tp)),
         (ends_with("wo/kernel", "ffn_out/kernel"), P(tp, fsdp)),
-        (ends_with("embed/embedding"), P(tp, fsdp)),
+        # Without a real (size>1) tp, fsdp splits the vocab dim — a
+        # feature-dim shard forces a full remat of dx in the backward
+        # scatter (llama.py).
+        (ends_with("embed/embedding"),
+         P(tp, fsdp) if active_mesh_axis(mesh, TP) else P(fsdp, None)),
     ]

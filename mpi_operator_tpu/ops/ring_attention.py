@@ -50,6 +50,74 @@ NEG_INF = -1e30
 
 
 # ---------------------------------------------------------------------------
+# tp-manual kernel region (pipeline composition)
+# ---------------------------------------------------------------------------
+
+
+def _auto_tp_size() -> int:
+    """Size of a tp mesh axis that is AUTO in the current trace context
+    — 0 when absent, size 1, already manual, or outside a mesh context.
+
+    Inside the pipeline's partial-manual shard_map (manual over
+    dp/fsdp/sp/pp, tp left to GSPMD — models/llama_pp.py) this is the
+    tp degree the auto-partitioner will shard head dims over."""
+    amesh = jax.sharding.get_abstract_mesh()
+    names = getattr(amesh, "axis_names", ())
+    if TP not in names:
+        return 0
+    if amesh.axis_types[names.index(TP)] != jax.sharding.AxisType.Auto:
+        return 0
+    size = amesh.shape[TP]
+    return size if size > 1 else 0
+
+
+def _flash_bshd_tp_manual(
+    q, k, v, row_ids, col_ids, *, causal, sm_scale, block_q, block_k
+):
+    """``flash_attention_bshd_lse`` with the pallas call completed to
+    MANUAL over tp (heads split over the tp axis via a nested
+    shard_map).
+
+    Needed whenever the kernel runs inside a partial-manual region
+    whose AUTO set contains tp (the pp pipeline stages): in interpret
+    mode the kernel internals are visible HLO, and the auto-partitioner
+    splits the in-kernel head slices over the tp-sharded [H·D] dim,
+    inserting halo collective-permutes inside ``pl.when`` branches
+    whose predicate is device-varying (the id-masked causal clamp
+    depends on ``axis_index(sp)``) — devices then join different
+    rendezvous and the XLA:CPU runtime deadlocks (hack/wedge_repro.py
+    reproduces and bisects this). With the kernel region manual over
+    tp there is nothing left for the auto-partitioner to touch; on TPU
+    the same wrapper is simply the explicit statement that heads shard
+    over tp (what ``bshd_sp_specs`` does in the non-pipelined path).
+
+    Caller guarantees tp divides both head counts."""
+    from .attention import flash_attention_bshd_lse
+
+    h_spec = P(None, None, TP, None)
+    have_ids = row_ids is not None
+    args = (q, k, v) + ((row_ids, col_ids) if have_ids else ())
+    in_specs = (h_spec, h_spec, h_spec) + ((P(), P()) if have_ids else ())
+
+    def call(a, b, c, *ids):
+        r, cc = ids if have_ids else (None, None)
+        return flash_attention_bshd_lse(
+            a, b, c, row_ids=r, col_ids=cc, causal=causal,
+            sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+        )
+
+    inner = jax.shard_map(
+        call,
+        mesh=jax.sharding.get_abstract_mesh(),
+        in_specs=in_specs,
+        out_specs=(h_spec, P(None, None, TP)),
+        axis_names=frozenset({TP}),
+        check_vma=False,  # pallas-in-shard_map vma workaround (below)
+    )
+    return inner(*args)
+
+
+# ---------------------------------------------------------------------------
 # Zigzag layout
 # ---------------------------------------------------------------------------
 
@@ -264,6 +332,7 @@ def ring_attention_bshd(
     zigzag: bool = False,
     block_q: int = 128,
     block_k: int = 128,
+    tp_manual: bool = False,
 ):
     """Per-shard ring attention over the PROJECTION layout — the
     sequence-parallel twin of ``attention.flash_attention_bshd``.
@@ -272,7 +341,12 @@ def ring_attention_bshd(
     over ``axis_name`` (contiguous, or zigzag chunk pairs). Identical
     ring/merge structure to :func:`ring_attention`, but every per-hop
     partial is the flat kernel and the merge runs on [B, S, H]-shaped
-    lse — zero layout changes anywhere on the path."""
+    lse — zero layout changes anywhere on the path.
+
+    ``tp_manual=True``: each per-hop kernel runs inside a nested
+    manual-over-tp region (``_flash_bshd_tp_manual``) — required when
+    the caller sits in a partial-manual region whose AUTO set contains
+    tp (the pp pipeline); tp must divide both head counts."""
     from .attention import flash_attention_bshd_lse
 
     n = jax.lax.axis_size(axis_name)
@@ -285,17 +359,27 @@ def ring_attention_bshd(
     if zigzag and s_loc % 2:
         raise ValueError(f"zigzag needs an even local seq, got {s_loc}")
 
+    if tp_manual:
+        flash = functools.partial(
+            _flash_bshd_tp_manual, causal=False,
+            sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+        )
+    else:
+        flash = lambda a, b_, c, r, cc: flash_attention_bshd_lse(
+            a, b_, c, row_ids=r, col_ids=cc,
+            sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+        )
+
     row = _shard_ids(my, n, s_loc, zigzag)
 
     def step(carry, t):
         o, lse, k_cur, v_cur = carry
         src = jax.lax.rem(my - t + n, n)
         col = _shard_ids(src, n, s_loc, zigzag)
-        o_t, lse_t = flash_attention_bshd_lse(
+        o_t, lse_t = flash(
             q, k_cur, v_cur,
-            row_ids=row if causal else None,
-            col_ids=col if causal else None,
-            sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+            row if causal else None,
+            col if causal else None,
         )
         o_t = o_t.astype(jnp.float32)
         lse_new = jnp.logaddexp(lse, lse_t)
@@ -362,10 +446,13 @@ def sp_attention_bshd(
     dispatch bert/llama call on the RAW [B, S, H, D] projections before
     any transpose. Handles the transpose-free impls: 'flash' (flat
     kernel), 'ring'/'ulysses' (sequence-parallel twins; need a mesh
-    with an sp axis). Returns ``None`` for impls that live on the
-    [B, H, S, D] path (dense oracle, flash-bhsd A/B, the pipeline's
-    '-shard' variants) — the caller then transposes and falls through
-    to :func:`sp_attention`, which raises on unknown names."""
+    with an sp axis), and the pipeline's in-manual-region
+    'ring-shard'/'ulysses-shard' (tp-manual kernel regions when tp is
+    an auto axis). Returns ``None`` for impls that live on the
+    [B, H, S, D] path (dense oracle, flash-bhsd A/B, '-shard' when an
+    auto tp does not divide the head counts) — the caller then
+    transposes and falls through to :func:`sp_attention`, which raises
+    on unknown names."""
     from .attention import flash_attention_bshd
 
     if impl == "flash":
@@ -387,6 +474,37 @@ def sp_attention_bshd(
         return ring_attention_bshd_shard_mapped(
             q, k, v, mesh, causal=causal, zigzag=zigzag,
             block_q=block_q, block_k=block_k,
+        )
+    if impl in ("ring-shard", "ulysses-shard"):
+        # Already inside a manual region over sp (the pp×sp pipeline
+        # stages — llama_pp). The flat kernels run here too, but when
+        # tp rides along as an AUTO axis the kernel region must be
+        # completed to manual over tp (``_flash_bshd_tp_manual`` — the
+        # auto-partitioner deadlocks the runtime if it reaches the
+        # interpret-mode kernel internals), which needs tp to divide
+        # the per-kernel head counts. When it does not, return None:
+        # the caller falls through to the [B, H, S, D] per-hop path.
+        h, h_kv = q.shape[2], k.shape[2]
+        tp = _auto_tp_size()
+        if impl == "ring-shard":
+            if tp and (h % tp or h_kv % tp):
+                return None
+            return ring_attention_bshd(
+                q, k, v, SP, causal=causal, zigzag=zigzag,
+                block_q=block_q, block_k=block_k, tp_manual=bool(tp),
+            )
+        from .ulysses import _replicate_kv_for, ulysses_attention_bshd
+
+        sp_size = jax.lax.axis_size(SP)
+        if h % sp_size:
+            return None  # invalid for ulysses in any layout; the
+            # [B, H, S, D] path raises the canonical error.
+        rep = _replicate_kv_for(h_kv, sp_size)
+        if tp and ((h // sp_size) % tp or (h_kv * rep // sp_size) % tp):
+            return None
+        return ulysses_attention_bshd(
+            q, k, v, SP, causal=causal,
+            block_q=block_q, block_k=block_k, tp_manual=bool(tp),
         )
     return None
 

@@ -174,6 +174,7 @@ def ulysses_attention_bshd(
     sm_scale: Optional[float] = None,
     block_q: int = 128,
     block_k: int = 128,
+    tp_manual: bool = False,
 ):
     """Per-shard Ulysses attention over the PROJECTION layout — the
     sequence-parallel twin of ``attention.flash_attention_bshd``.
@@ -205,10 +206,23 @@ def ulysses_attention_bshd(
         )
         q, k, v = a2a(q), a2a(k), a2a(v)
 
-    out = flash_attention_bshd(
-        q, k, v, causal=causal, sm_scale=sm_scale,
-        block_q=block_q, block_k=block_k,
-    )
+    if tp_manual:
+        # Pipeline composition (tp as an AUTO axis around this manual
+        # region): run the kernel inside a nested manual-over-tp region
+        # so the auto-partitioner never reaches its internals — see
+        # ring_attention._flash_bshd_tp_manual. Caller guarantees tp
+        # divides the post-all-to-all head counts.
+        from .ring_attention import _flash_bshd_tp_manual
+
+        out, _ = _flash_bshd_tp_manual(
+            q, k, v, None, None, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k,
+        )
+    else:
+        out = flash_attention_bshd(
+            q, k, v, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k,
+        )
 
     if n > 1:
         out = jax.lax.all_to_all(
