@@ -70,6 +70,12 @@ run llama-b8-mu-bf16   --suite llama --llama-batch 8 --adam-mu-dtype bf16
 # re-read, the dominant kernel-internal DMA).
 run bert-fb512         --suite bert --flash-block-q 512 --flash-block-k 512
 run llama-fb256        --suite llama --flash-block-q 256 --flash-block-k 256
+# ViT north-star configs: batch 128 models a 48% ceiling (HBM-bound),
+# batch 256 models 59% (param/optimizer traffic amortizes — the bytes
+# grow 1.8x while FLOPs grow 2.2x; hlo_traffic sweep, round 5). The
+# remat point (56% modeled) is the fallback if b256 activations OOM.
+run vit-b256           --suite vit --vit-batch 256
+run vit-b256-remat     --suite vit --vit-batch 256 --vit-remat
 # ResNet A/Bs: scanned stages (compile-friendly form) and pallas BN.
 # Chipless-AOT analysis (docs/round3-notes.md) localized round 3's
 # 29-min "hang" to the eager-init kernel storm (fixed: init is jitted)

@@ -72,16 +72,11 @@ TIMEOUT_S = float(os.environ.get("WEDGE_TIMEOUT_S", "600"))
 
 
 def _env_cpu(n_devices: int) -> dict:
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = [f for f in env.get("XLA_FLAGS", "").split()
-             if not f.startswith("--xla_force_host_platform_device_count")]
-    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
-    env["XLA_FLAGS"] = " ".join(flags)
-    for var in ("TPU_LIBRARY_PATH", "PJRT_DEVICE", "TPU_NAME",
-                "PALLAS_AXON_POOL_IPS"):
-        env.pop(var, None)
-    return env
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mpi_operator_tpu.utils.env import cpu_subprocess_env
+
+    return cpu_subprocess_env(n_devices)
 
 
 # --------------------------------------------------------------------------

@@ -72,10 +72,18 @@ class APIServerFrontend:
         self.expire_continue = False
         self._knob_lock = threading.Lock()
         # Watch cache: rv-ordered (rv, WatchEvent) history per resource,
-        # fed by one persistent watch per resource.
+        # fed by one persistent watch per resource. ``_compacted`` is
+        # the continuity watermark: the rv of the newest event ever
+        # DROPPED from the history (by the ring limit or compact()).
+        # A watch rv below it must 410 even when the history is empty —
+        # an empty cache means "cannot prove continuity", not "nothing
+        # happened". (Conflating the two left a reconnecting idle watch
+        # silently stale forever; found by
+        # tests/test_properties.py:TestWatchContractProperties.)
         self._history: dict[str, list[tuple[int, WatchEvent]]] = {
             plural: [] for plural in RESOURCES
         }
+        self._compacted: dict[str, int] = {plural: 0 for plural in RESOURCES}
         self._hist_lock = threading.Condition()
         self._recorders = [api.watch(plural) for plural in RESOURCES]
         self._recorder_thread = threading.Thread(
@@ -124,7 +132,12 @@ class APIServerFrontend:
                         hist = self._history[event.resource]
                         hist.append((rv, event))
                         if len(hist) > self.history_limit:
-                            del hist[: len(hist) - self.history_limit]
+                            drop = len(hist) - self.history_limit
+                            self._compacted[event.resource] = max(
+                                self._compacted[event.resource],
+                                hist[drop - 1][0],
+                            )
+                            del hist[:drop]
                         self._hist_lock.notify_all()
             if not got:
                 time.sleep(0.005)
@@ -133,7 +146,11 @@ class APIServerFrontend:
         """Drop all history — every watch resume from an old rv now 410s
         (simulates etcd compaction for resume tests)."""
         with self._hist_lock:
-            for hist in self._history.values():
+            for plural, hist in self._history.items():
+                if hist:
+                    self._compacted[plural] = max(
+                        self._compacted[plural], hist[-1][0]
+                    )
                 hist.clear()
 
     def oldest_rv(self, resource: str) -> Optional[int]:
@@ -151,8 +168,15 @@ class APIServerFrontend:
             while True:
                 hist = self._history[resource]
                 # Re-checked every wakeup: an event arriving *while we
-                # block* can evict the window our rv needs.
-                if hist and rv < hist[0][0] - 1:
+                # block* can evict the window our rv needs. The
+                # watermark is exact — the newest rv ever dropped from
+                # this resource's history — and covers the
+                # empty-history case (compaction with an idle stream
+                # must still 410, or the client waits forever on a
+                # provably stale rv). No adjacency heuristic: rvs come
+                # from one global counter, so per-resource gaps are
+                # normal, not evidence of loss.
+                if rv < self._compacted[resource]:
                     return None
                 out = [(erv, e) for erv, e in hist if erv > rv]
                 if out or self._stopped:
@@ -339,11 +363,16 @@ class _Handler(BaseHTTPRequestHandler):
         })
 
     def _newest_known_rv(self) -> int:
+        # The compaction watermark counts as "known": a list served
+        # right after a compaction must not hand out a collection rv
+        # below it, or the client's follow-up watch 410s, relists to the
+        # same stale rv, and livelocks (410 -> relist -> 410 ...).
         newest = 0
         with self.frontend._hist_lock:
             for hist in self.frontend._history.values():
                 if hist:
                     newest = max(newest, hist[-1][0])
+            newest = max(newest, *self.frontend._compacted.values())
         return newest
 
     def do_POST(self):  # noqa: N802

@@ -666,10 +666,15 @@ class KubeAPIServer:
         (``limit``/``continue``, the Reflector's chunked-list
         discipline) so a large cluster never forces one giant response.
         An expired continue token (410 mid-pagination) restarts the
-        whole list — pages from different snapshots must not be mixed.
+        whole list — pages from different snapshots must not be mixed —
+        and the restart is UNPAGINATED (client-go's ListPager fallback:
+        a plain list has no continuation to expire, so one retry always
+        suffices even against a server compacting every snapshot;
+        pinned by tests/test_properties.py's pagination property).
         """
         sel = _selector_query(label_selector)
         path = resource_path(resource, namespace)
+        use_limit = bool(self.page_limit)
         for _restart in range(4):
             items: list[dict] = []
             rv = ""
@@ -677,7 +682,7 @@ class KubeAPIServer:
             while True:
                 query = {
                     "labelSelector": sel,
-                    "limit": str(self.page_limit) if self.page_limit else None,
+                    "limit": str(self.page_limit) if use_limit else None,
                     "continue": cont,
                 }
                 try:
@@ -686,7 +691,8 @@ class KubeAPIServer:
                     )
                 except ApiError as e:
                     if cont is not None and getattr(e, "code", 0) == 410:
-                        break  # token expired: restart from page one
+                        use_limit = False  # token expired: restart
+                        break              # from page one, unpaginated
                     raise
                 items += [
                     self._stamp(resource, o)

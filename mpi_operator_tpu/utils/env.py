@@ -1,0 +1,38 @@
+"""Environment plumbing for CPU-virtual-device subprocesses."""
+
+from __future__ import annotations
+
+import os
+
+# Env vars that can hand a subprocess the real accelerator. The first
+# is the image's sitecustomize trigger: if it survives into the child,
+# the axon TPU platform registers at interpreter startup — BEFORE the
+# child's own JAX_PLATFORMS takes effect — and a down tunnel then
+# wedges backend init (or worse, a live one gets grabbed mid-bench).
+TPU_ENV_VARS = (
+    "PALLAS_AXON_POOL_IPS",
+    "TPU_LIBRARY_PATH",
+    "PJRT_DEVICE",
+    "TPU_NAME",
+)
+
+
+def cpu_subprocess_env(n_devices: int) -> dict:
+    """A copy of ``os.environ`` pinned to ``n_devices`` virtual CPU
+    devices, with every way of grabbing a real TPU scrubbed.
+
+    The single source of truth for chipless subprocess harnesses
+    (``__graft_entry__.dryrun_multichip``, ``hack/wedge_repro.py``):
+    the scrub list must stay in lockstep across them, or a stage grabs
+    the real chip and can wedge the tunnel for hours."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    for var in TPU_ENV_VARS:
+        env.pop(var, None)
+    return env

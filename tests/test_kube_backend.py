@@ -253,10 +253,12 @@ class TestWatchOverHttp:
             w.stop()
 
     def test_410_resume_relists_and_diffs(self):
-        # A 1-entry watch cache: any event whose rv is not adjacent to the
-        # stream's position compacts the stream's resourceVersion away ->
-        # the server answers with an in-stream 410 -> the client must
+        # A 1-entry watch cache: while the stream is DOWN, a second pods
+        # event evicts the first, advancing the compaction watermark past
+        # the reader's position -> the reconnect 410s -> the client must
         # relist, diff against its mirror, and carry on seamlessly.
+        # (Cross-resource rv gaps alone must NOT 410: rvs come from one
+        # global counter, so gaps are normal — the watermark is exact.)
         fe = APIServerFrontend(InMemoryAPIServer(), history_limit=1).start()
         kube = KubeAPIServer(RestConfig(host=fe.url))
         w = kube.watch("pods")
@@ -274,16 +276,19 @@ class TestWatchOverHttp:
                 return check
 
             wait_for(collect({"old"}), msg="first event")
-            # Burn resourceVersions on another resource so the next pods
-            # event lands non-adjacent (and evicts 'old' from the cache).
-            for i in range(3):
-                kube.create("configmaps", {
-                    "metadata": {"name": f"cm{i}", "namespace": "default"},
-                })
+            # Drop the stream, then burn two pods events while it is
+            # down: the second evicts the first from the 1-entry cache,
+            # so the reader's reconnect rv is below the watermark.
+            w._conn.close()
+            kube.create("pods", pod("evicted"))
+            kube.delete("pods", "default", "evicted")
             kube.create("pods", pod("fresh"))
             wait_for(collect({"fresh"}), msg="resume diff delivers fresh")
             assert seen["fresh"] == [ADDED]
             assert seen["old"] == [ADDED]  # relist diff emits no duplicate
+            # 'evicted' lived and died inside the blind window: the
+            # relist diff must never surface it.
+            assert "evicted" not in seen
             assert w.relist_count >= 1
             # The resumed stream keeps working.
             kube.delete("pods", "default", "old")
