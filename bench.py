@@ -214,6 +214,21 @@ def _mu_dtype(args):
     return jnp.bfloat16 if args.adam_mu_dtype == "bf16" else None
 
 
+def _resolved_config(args) -> dict:
+    """The perf knobs a transformer suite actually ran with — embedded
+    in the emitted JSON line so same-label rows across captures stay
+    comparable across default retunes (the labels in BENCH_CAPTURE.jsonl
+    predate the r5 fb256/xc1024 default change)."""
+    return {
+        "attention_impl": args.attention_impl,
+        "flash_block_q": args.flash_block_q,
+        "flash_block_k": args.flash_block_k,
+        "xent_chunk": args.xent_chunk,
+        "remat_policy": args.remat_policy,
+        "adam_mu_dtype": args.adam_mu_dtype,
+    }
+
+
 def _timed_steps_maybe_profiled(fn, state, args_rest, args):
     """`_timed_steps` with the optional ``--profile-dir`` capture every
     suite shares: warm/compile fully BEFORE the trace so it holds only
@@ -421,6 +436,9 @@ def bench_bert(args) -> dict:
         "unit": f"seq({seq_len})/sec/chip",
         # No reference transformer baseline exists; report MFU fraction.
         "vs_baseline": round(tflops / peak, 3),
+        # Resolved perf knobs, so same-label rows across captures are
+        # comparable even after a default retune (r5 review finding).
+        "config": _resolved_config(args),
     }
 
 
@@ -508,6 +526,7 @@ def bench_llama(args) -> dict:
         "value": round(tokens_per_sec, 1),
         "unit": f"tokens({seq_len})/sec/chip",
         "vs_baseline": round(tflops / peak, 3),
+        "config": _resolved_config(args),
     }
 
 
@@ -585,6 +604,7 @@ def bench_vit(args) -> dict:
         "unit": "images/sec/chip",
         # No reference transformer baseline exists; report MFU fraction.
         "vs_baseline": round(tflops / peak, 3),
+        "config": _resolved_config(args),
     }
 
 
@@ -1040,13 +1060,18 @@ def build_parser() -> argparse.ArgumentParser:
                         help="bert/llama suites: layer checkpoint policy "
                              "(dots = save matmul outputs; full = save "
                              "only layer boundaries, +~33%% FLOPs)")
-    parser.add_argument("--xent-chunk", type=int, default=512,
+    parser.add_argument("--xent-chunk", type=int, default=1024,
                         help="llama suite: chunked head+CE positions per "
-                             "chunk (0 = unchunked)")
-    parser.add_argument("--flash-block-q", type=int, default=128,
-                        help="flash attention q-tile (bert/llama suites)")
-    parser.add_argument("--flash-block-k", type=int, default=128,
-                        help="flash attention k-tile (bert/llama suites)")
+                             "chunk (0 = unchunked). 1024 measured best "
+                             "on v5e (TUNE_CAPTURE r5: 53.1%% vs 52.1%% "
+                             "at 512, 46.9%% at 2048)")
+    parser.add_argument("--flash-block-q", type=int, default=256,
+                        help="flash attention q-tile (bert/llama/vit "
+                             "suites). 256 measured best on v5e for all "
+                             "three (TUNE_CAPTURE r5; 512 exceeds the "
+                             "16M scoped-vmem limit in the bwd kernel)")
+    parser.add_argument("--flash-block-k", type=int, default=256,
+                        help="flash attention k-tile (see --flash-block-q)")
     parser.add_argument("--adam-mu-dtype", choices=["f32", "bf16"],
                         default="f32",
                         help="bert/llama suites: dtype of adamw's first "

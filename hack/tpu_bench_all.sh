@@ -58,29 +58,37 @@ run decode             --suite decode
 # round-3 MFU gap analysis; see docs/round3-notes.md). The suites above
 # already run the flat [B,S,H·D] kernels (the round-4 default); the
 # bhsd lines time the old transpose-convention layout against them.
-run bert-flash-bhsd    --suite bert --attention-impl flash-bhsd
-run llama-flash-bhsd   --suite llama --attention-impl flash-bhsd
+# (A/B rows pin tiles/chunk explicitly — same rule as tpu_tune.py —
+# so their labels stay comparable with the r5 rows even though the
+# suite defaults moved to fb256/xc1024.)
+run bert-flash-bhsd    --suite bert --attention-impl flash-bhsd \
+    --flash-block-q 128 --flash-block-k 128
+run llama-flash-bhsd   --suite llama --attention-impl flash-bhsd \
+    --flash-block-q 128 --flash-block-k 128 --xent-chunk 512
 run bert-dense-attn    --suite bert --attention-impl dense
-run llama-dense-attn   --suite llama --attention-impl dense
-# Batch-8 via bf16 adam first moment (no extra FLOPs; fits 16G).
+run llama-dense-attn   --suite llama --attention-impl dense --xent-chunk 512
+# Batch-8 via bf16 adam first moment: REFUTED r5 — activation temps
+# blow 16G at remote compile even with bf16 mu (receipt in PERF.md).
+# Kept as a canary for future HBM-larger parts.
 run llama-b8-mu-bf16   --suite llama --llama-batch 8 --adam-mu-dtype bf16
-# Tile sweep headliners (the full sweep runs last via tpu_tune, but the
-# tunnel can die mid-window — capture the single most promising point
-# of each suite early: larger q-tiles divide the flash kernels' k/v
-# re-read, the dominant kernel-internal DMA).
-run bert-fb512         --suite bert --flash-block-q 512 --flash-block-k 512
-run llama-fb256        --suite llama --flash-block-q 256 --flash-block-k 256
-# ViT north-star configs: batch 128 models a 48% ceiling (HBM-bound),
-# batch 256 models 59% (param/optimizer traffic amortizes — the bytes
-# grow 1.8x while FLOPs grow 2.2x; hlo_traffic sweep, round 5). The
-# remat point (56% modeled) is the fallback if b256 activations OOM.
-run vit-b256           --suite vit --vit-batch 256
-run vit-b256-remat     --suite vit --vit-batch 256 --vit-remat
-# ResNet A/Bs: scanned stages (compile-friendly form) and pallas BN.
-# Chipless-AOT analysis (docs/round3-notes.md) localized round 3's
-# 29-min "hang" to the eager-init kernel storm (fixed: init is jitted)
-# and measured scan+pallas compiling FASTER than plain xla — but run
-# the bn probe first anyway, and prefer the scan form for pallas.
+# Tile controls: suite defaults are the measured winners (fb256 +
+# xc1024, TUNE_CAPTURE r5) — these pin the round-4 values so the
+# kernel-internal k/v re-read delta stays visible run over run.
+run bert-fb128-ctrl    --suite bert --flash-block-q 128 --flash-block-k 128
+run llama-fb128-xc512-ctrl --suite llama --flash-block-q 128 \
+    --flash-block-k 128 --xent-chunk 512
+# ViT batch points (r5: batch does NOT amortize — b128 wins; kept to
+# watch for regressions against that verdict).
+run vit-b256           --suite vit --vit-batch 256 \
+    --flash-block-q 256 --flash-block-k 256
+run vit-b256-remat     --suite vit --vit-batch 256 --vit-remat \
+    --flash-block-q 256 --flash-block-k 256
+# ResNet A/Bs: scanned stages and pallas BN. R5 hardware verdicts:
+# xla-scan OOMs HBM by 25M at batch 128 (scan carries stage buffers);
+# pallas BN loses to XLA's fusion in the isolated ladder (114 vs
+# 132 GB/s) and whole-model (855.9 img/s vs 1865.1). Defaults
+# (bn=xla, unrolled) are the measured winners; lines kept as
+# regression canaries against those verdicts.
 run resnet101-scan     --suite resnet --scan-stages
 python hack/bn_probe.py 1 && python hack/bn_probe.py 5 \
   && run resnet101-bn-pallas-scan --suite resnet --bn-kernel pallas --scan-stages

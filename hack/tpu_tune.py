@@ -43,45 +43,87 @@ def ns(**kw) -> argparse.Namespace:
 
 LLAMA_SWEEP = [
     # name, overrides — ordered so the most informative A/Bs come first.
-    ("base-b4-dots-fb128", {}),
+    # PINNING RULE (post-r5, when the bench defaults moved to the
+    # measured winners fb256/xc1024): every point pins flash tiles AND
+    # xent_chunk explicitly, so labels are self-contained and a future
+    # default change cannot silently re-confound a ladder. The tile
+    # ladder holds xc512 (comparable with the r5 rows); the
+    # batch/remat/memory points hold the winning fb256+xc1024 so they
+    # measure ONLY their own lever against the capture base (26,934
+    # tok/s — BENCH_CAPTURE llama-fb256-xc1024).
+    ("base-b4-dots-fb128", {"flash_block_q": 128, "flash_block_k": 128,
+                            "xent_chunk": 512}),
     # Batch-8 unlock with NO extra FLOPs: bf16 adam first moment frees
     # 1.48 GB, vs full-remat-b8's +33% recompute (both points stay).
-    ("b8-dots-mu-bf16", {"llama_batch": 8, "adam_mu_dtype": "bf16"}),
+    # r5: REFUTED at compile (activation temps blow 16G) — kept as a
+    # canary for larger-HBM parts.
+    ("b8-dots-mu-bf16", {"llama_batch": 8, "adam_mu_dtype": "bf16",
+                         "flash_block_q": 256, "flash_block_k": 256,
+                         "xent_chunk": 1024}),
     # Kernel-layout A/B: flat [B,S,H·D] (default) vs the transpose
     # convention — isolates the layout-copy elimination.
-    ("flash-bhsd", {"attention_impl": "flash-bhsd"}),
-    ("dense-attn", {"attention_impl": "dense"}),
-    ("fb256", {"flash_block_q": 256, "flash_block_k": 256}),
-    ("fb512", {"flash_block_q": 512, "flash_block_k": 512}),
-    ("fb512q-256k", {"flash_block_q": 512, "flash_block_k": 256}),
-    ("full-remat-b8", {"remat_policy": "full", "llama_batch": 8}),
-    ("full-remat-b4", {"remat_policy": "full"}),
-    ("xent-chunk-1024", {"xent_chunk": 1024}),
-    ("xent-chunk-2048", {"xent_chunk": 2048}),
-    ("seq4096-b2", {"seq_len": 4096, "llama_batch": 2}),
-    ("b6-dots", {"llama_batch": 6}),
+    ("flash-bhsd", {"attention_impl": "flash-bhsd",
+                    "flash_block_q": 128, "flash_block_k": 128,
+                    "xent_chunk": 512}),
+    ("dense-attn", {"attention_impl": "dense", "xent_chunk": 512}),
+    # Tile ladder at xc512 (one knob at a time).
+    ("fb256", {"flash_block_q": 256, "flash_block_k": 256,
+               "xent_chunk": 512}),
+    ("fb512", {"flash_block_q": 512, "flash_block_k": 512,
+               "xent_chunk": 512}),    # r5: VMEM-infeasible canary
+    ("fb512q-256k", {"flash_block_q": 512, "flash_block_k": 256,
+                     "xent_chunk": 512}),
+    ("full-remat-b8", {"remat_policy": "full", "llama_batch": 8,
+                       "flash_block_q": 256, "flash_block_k": 256,
+                       "xent_chunk": 1024}),
+    ("full-remat-b4", {"remat_policy": "full",
+                       "flash_block_q": 256, "flash_block_k": 256,
+                       "xent_chunk": 1024}),
+    # Chunk ladder at the winning tiles.
+    ("xent-chunk-512", {"xent_chunk": 512,
+                        "flash_block_q": 256, "flash_block_k": 256}),
+    ("xent-chunk-2048", {"xent_chunk": 2048,
+                         "flash_block_q": 256, "flash_block_k": 256}),
+    ("seq4096-b2", {"seq_len": 4096, "llama_batch": 2,
+                    "flash_block_q": 256, "flash_block_k": 256,
+                    "xent_chunk": 1024}),
+    ("b6-dots", {"llama_batch": 6,
+                 "flash_block_q": 256, "flash_block_k": 256,
+                 "xent_chunk": 1024}),
 ]
 
 BERT_SWEEP = [
-    ("base-b64-fb128", {"suite": "bert"}),
-    ("flash-bhsd", {"suite": "bert", "attention_impl": "flash-bhsd"}),
+    # Same pinning rule as LLAMA_SWEEP (bert has no xent_chunk knob).
+    ("base-b64-fb128", {"suite": "bert",
+                        "flash_block_q": 128, "flash_block_k": 128}),
+    ("flash-bhsd", {"suite": "bert", "attention_impl": "flash-bhsd",
+                    "flash_block_q": 128, "flash_block_k": 128}),
     ("dense-attn", {"suite": "bert", "attention_impl": "dense"}),
     ("fb256", {"suite": "bert", "flash_block_q": 256, "flash_block_k": 256}),
-    ("fb512", {"suite": "bert", "flash_block_q": 512, "flash_block_k": 512}),
-    ("b128", {"suite": "bert", "bert_batch": 128}),
-    ("b256-remat", {"suite": "bert", "bert_batch": 256, "bert_remat": True}),
+    ("fb512", {"suite": "bert", "flash_block_q": 512,
+               "flash_block_k": 512}),  # r5: VMEM-infeasible canary
+    # Batch ladder at fb128 (comparable with the r5 rows) and at the
+    # winning fb256.
+    ("b128-fb128", {"suite": "bert", "bert_batch": 128,
+                    "flash_block_q": 128, "flash_block_k": 128}),
+    ("b256-remat", {"suite": "bert", "bert_batch": 256, "bert_remat": True,
+                    "flash_block_q": 256, "flash_block_k": 256}),
     ("b128-fb256", {"suite": "bert", "bert_batch": 128,
                     "flash_block_q": 256, "flash_block_k": 256}),
 ]
 
 
 VIT_SWEEP = [
-    ("base-b128", {"suite": "vit"}),
+    # Same pinning rule.
+    ("base-b128", {"suite": "vit",
+                   "flash_block_q": 128, "flash_block_k": 128}),
     ("dense-attn", {"suite": "vit", "attention_impl": "dense"}),
     ("fb256", {"suite": "vit", "flash_block_q": 256,
                "flash_block_k": 256}),
-    ("b256-remat", {"suite": "vit", "vit_batch": 256, "vit_remat": True}),
-    ("b64", {"suite": "vit", "vit_batch": 64}),
+    ("b256-remat", {"suite": "vit", "vit_batch": 256, "vit_remat": True,
+                    "flash_block_q": 256, "flash_block_k": 256}),
+    ("b64", {"suite": "vit", "vit_batch": 64,
+             "flash_block_q": 256, "flash_block_k": 256}),
 ]
 
 _SWEEPS = {

@@ -79,7 +79,7 @@ class APIServerFrontend:
         # an empty cache means "cannot prove continuity", not "nothing
         # happened". (Conflating the two left a reconnecting idle watch
         # silently stale forever; found by
-        # tests/test_properties.py:TestWatchContractProperties.)
+        # tests/test_properties_operator.py:TestWatchContractProperties.)
         self._history: dict[str, list[tuple[int, WatchEvent]]] = {
             plural: [] for plural in RESOURCES
         }

@@ -670,7 +670,8 @@ class KubeAPIServer:
         and the restart is UNPAGINATED (client-go's ListPager fallback:
         a plain list has no continuation to expire, so one retry always
         suffices even against a server compacting every snapshot;
-        pinned by tests/test_properties.py's pagination property).
+        pinned by tests/test_properties_operator.py's pagination
+        property).
         """
         sel = _selector_query(label_selector)
         path = resource_path(resource, namespace)

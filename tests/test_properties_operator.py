@@ -1,0 +1,205 @@
+"""Property-based tests (hypothesis) for the operator runtime's
+client/server contracts: workqueue dedup/redelivery semantics and the
+kube client<->apiserver watch contract. Split from test_properties.py
+(which keeps the kernel-tier math properties) so each lands in its
+domain's test tier — these exercise runtime/{workqueue,kube,httpserver},
+not kernels.
+"""
+
+import time
+
+from hypothesis import given, settings, strategies as st
+
+from mpi_operator_tpu.runtime.apiserver import DELETED
+
+
+class TestWorkqueueProperties:
+    """kubeflow workqueue semantics over arbitrary interleavings: an
+    item is never handed out twice concurrently, re-adds during
+    processing are not lost, and the exponential limiter is monotone
+    up to its cap and resets on forget."""
+
+    @settings(max_examples=40, deadline=None)
+    @given(st.lists(st.tuples(st.integers(0, 4), st.booleans()),
+                    min_size=1, max_size=40))
+    def test_no_item_is_lost_or_duplicated(self, ops):
+        from mpi_operator_tpu.runtime.workqueue import RateLimitingQueue
+
+        q = RateLimitingQueue()
+        in_flight = set()
+        added_while_processing = set()
+        for item, do_get in ops:
+            q.add(item)
+            if item in in_flight:
+                added_while_processing.add(item)
+            if do_get and len(q):
+                got, shutdown = q.get(timeout=0.1)
+                assert not shutdown
+                # Dedup invariant: never concurrently handed out twice.
+                assert got not in in_flight
+                in_flight.add(got)
+        # Finish everything; anything re-added mid-processing must come
+        # around again (the dirty-set redelivery contract).
+        redelivered = set()
+        for item in list(in_flight):
+            q.done(item)
+        while len(q):
+            got, shutdown = q.get(timeout=0.1)
+            assert not shutdown
+            redelivered.add(got)
+            q.done(got)
+        assert added_while_processing <= redelivered | in_flight
+        q.shutdown()
+
+    @settings(max_examples=40, deadline=None)
+    @given(st.integers(min_value=1, max_value=30))
+    def test_limiter_monotone_and_capped(self, n):
+        from mpi_operator_tpu.runtime.workqueue import (
+            ItemExponentialFailureRateLimiter,
+        )
+
+        rl = ItemExponentialFailureRateLimiter(base_delay=0.01, max_delay=1.0)
+        delays = [rl.when("x") for _ in range(n)]
+        assert all(b >= a for a, b in zip(delays, delays[1:]))
+        assert delays[-1] <= 1.0 + 1e-9
+        assert rl.num_requeues("x") == n
+        rl.forget("x")
+        assert rl.num_requeues("x") == 0
+        assert rl.when("x") == delays[0]  # reset to base
+
+
+class TestWatchContractProperties:
+    """Hypothesis-driven client<->server watch-contract tests over real
+    HTTP: random interleavings of creates/updates/deletes, watch-cache
+    compactions, and stream disconnects against the envtest-analog
+    apiserver (runtime/httpserver.py), with the REST client's watch
+    (runtime/kube.py:KubeWatch) on the other end.
+
+    The invariant is client-go's losslessness contract: the opening
+    list plus every delivered event, applied in order, reconstructs the
+    server's final state exactly — through reconnects, 410 relists
+    (tiny history_limit makes compactions routine, explicit compact()
+    ops force them), and paginated relists. Reference discipline:
+    /root/reference/v2/test/integration/main_test.go:116-178.
+    """
+
+    NAMES = ("a", "b", "c")
+
+    @staticmethod
+    def _pod(name):
+        return {
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{"name": "m", "image": "busybox"}]},
+        }
+
+    @settings(max_examples=12, deadline=None)
+    @given(
+        ops=st.lists(
+            st.one_of(
+                st.tuples(st.just("create"), st.integers(0, 2)),
+                st.tuples(st.just("update"), st.integers(0, 2)),
+                st.tuples(st.just("delete"), st.integers(0, 2)),
+                st.tuples(st.just("compact"), st.just(0)),
+                st.tuples(st.just("disconnect"), st.just(0)),
+            ),
+            min_size=1, max_size=14,
+        ),
+        page_limit=st.integers(min_value=0, max_value=2),
+    )
+    def test_watch_losslessness(self, ops, page_limit):
+        from mpi_operator_tpu.runtime.apiserver import (
+            AlreadyExistsError,
+            ConflictError,
+            InMemoryAPIServer,
+            NotFoundError,
+        )
+        from mpi_operator_tpu.runtime.httpserver import APIServerFrontend
+        from mpi_operator_tpu.runtime.kube import KubeAPIServer, RestConfig
+
+        # history_limit=2: even without explicit compact ops, any burst
+        # of >2 events while the stream is down forces the 410 path.
+        fe = APIServerFrontend(InMemoryAPIServer(), history_limit=2).start()
+        kube = KubeAPIServer(
+            RestConfig(host=fe.url), page_limit=page_limit
+        )
+        try:
+            w = kube.watch("pods")
+            key = lambda o: (o["metadata"].get("namespace", ""),
+                             o["metadata"]["name"])
+            rv = lambda o: o["metadata"].get("resourceVersion", "")
+            mirror = {key(o): rv(o) for o in w.baseline()}
+
+            for op, i in ops:
+                name = self.NAMES[i]
+                try:
+                    if op == "create":
+                        kube.create("pods", self._pod(name))
+                    elif op == "update":
+                        cur = kube.get("pods", "default", name)
+                        cur["metadata"].setdefault("labels", {})["touch"] = \
+                            str(int(cur["metadata"].get("labels", {})
+                                    .get("touch", "0")) + 1)
+                        kube.update("pods", cur)
+                    elif op == "delete":
+                        kube.delete("pods", "default", name)
+                    elif op == "compact":
+                        fe.compact()
+                    elif op == "disconnect":
+                        conn = w._conn
+                        if conn is not None:
+                            conn.close()  # reader thread must recover
+                except (AlreadyExistsError, NotFoundError, ConflictError):
+                    pass  # random interleavings legitimately collide
+
+            final = {key(o): rv(o) for o in kube.list("pods", "default")}
+
+            # Apply the stream until the mirror reconstructs the final
+            # state (reconnect after a disconnect takes ~0.2 s).
+            deadline = time.monotonic() + 20.0
+            while mirror != final:
+                for ev in w.drain():
+                    if ev.type == DELETED:
+                        mirror.pop(key(ev.object), None)
+                    else:
+                        mirror[key(ev.object)] = rv(ev.object)
+                if mirror == final:
+                    break
+                assert time.monotonic() < deadline, (
+                    f"watch never converged: mirror={mirror} final={final} "
+                    f"relists={w.relist_count} ops={ops}"
+                )
+                time.sleep(0.01)
+            w.stop()
+        finally:
+            kube.close()
+            fe.stop()
+
+    @settings(max_examples=15, deadline=None)
+    @given(
+        n=st.integers(min_value=0, max_value=9),
+        limit=st.integers(min_value=1, max_value=4),
+        expire=st.booleans(),
+    )
+    def test_paginated_list_equals_unpaginated(self, n, limit, expire):
+        """continue-token pagination (with or without every token
+        410ing — etcd compaction of the list snapshot) must yield the
+        same collection as one unpaginated list."""
+        from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer
+        from mpi_operator_tpu.runtime.httpserver import APIServerFrontend
+        from mpi_operator_tpu.runtime.kube import KubeAPIServer, RestConfig
+
+        fe = APIServerFrontend(InMemoryAPIServer()).start()
+        paged = KubeAPIServer(RestConfig(host=fe.url), page_limit=limit)
+        flat = KubeAPIServer(RestConfig(host=fe.url), page_limit=0)
+        try:
+            for i in range(n):
+                paged.create("pods", self._pod(f"p{i}"))
+            fe.expire_continue = expire
+            a = [o["metadata"]["name"] for o in paged.list("pods", "default")]
+            fe.expire_continue = False
+            b = [o["metadata"]["name"] for o in flat.list("pods", "default")]
+            assert a == b == [f"p{i}" for i in range(n)]
+        finally:
+            paged.close()
+            flat.close()
+            fe.stop()
