@@ -13,9 +13,9 @@ Default run (what the driver executes) benchmarks ResNet-101 and prints
 exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Other suites: --suite bert | llama | startup | all  (each prints its own
-single JSON line; `all` prints the headline line last and writes every
-result to PERF.md).
+Other suites: --suite bert | llama | vit | moe | decode | startup |
+operator-scale | all  (each prints its own single JSON line; `all`
+prints the headline line last and writes every result to PERF.md).
 """
 
 from __future__ import annotations
@@ -214,11 +214,13 @@ def _mu_dtype(args):
     return jnp.bfloat16 if args.adam_mu_dtype == "bf16" else None
 
 
-def _resolved_config(args) -> dict:
+def _resolved_config(args, **overrides) -> dict:
     """The perf knobs a transformer suite actually ran with — embedded
     in the emitted JSON line so same-label rows across captures stay
     comparable across default retunes (the labels in BENCH_CAPTURE.jsonl
-    predate the r5 fb256/xc1024 default change)."""
+    predate the r5 fb256/xc1024 default change). Suites that clamp or
+    force a knob (e.g. --moe-tiny) pass the value that actually ran as
+    an override."""
     return {
         "attention_impl": args.attention_impl,
         "flash_block_q": args.flash_block_q,
@@ -226,6 +228,7 @@ def _resolved_config(args) -> dict:
         "xent_chunk": args.xent_chunk,
         "remat_policy": args.remat_policy,
         "adam_mu_dtype": args.adam_mu_dtype,
+        **overrides,
     }
 
 
@@ -609,6 +612,121 @@ def bench_vit(args) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Mixtral-style sparse MoE (fourth transformer family: conditional compute)
+# ---------------------------------------------------------------------------
+
+
+def bench_moe(args) -> dict:
+    """Mixtral-style sparse-MoE Llama training throughput: 8 experts
+    routed top-2 (GShard static-shape dispatch, models/moe.py), sized so
+    total params + adamw state fit one v5e chip the way the dense 0.7B
+    llama suite does. MFU uses the ACTIVE-parameter convention (the
+    FLOPs a token actually executes: top_k experts + attention + head),
+    the standard accounting for conditional compute — total params are
+    logged beside it so the sparsity ratio is visible.
+    Reference analog: the operator runs whatever model the user image
+    ships (/root/reference/README.md:96-123); MoE is part of our
+    workload-layer parity surface (SURVEY.md §2.4).
+    """
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpi_operator_tpu.models import llama as llama_lib
+    from mpi_operator_tpu.parallel import create_mesh, shard_batch
+
+    n = len(jax.devices())
+    mesh = create_mesh(dp=-1)
+    seq_len = args.seq_len or 2048
+    if args.moe_tiny:
+        # CPU-testable contract path: toy widths, full code path.
+        cfg = llama_lib.tiny_moe(
+            n_experts=4, attention_impl="flash", max_seq_len=seq_len,
+            flash_block_q=min(args.flash_block_q, 64),
+            flash_block_k=min(args.flash_block_k, 64),
+        )
+    else:
+        # ~0.7B total / ~0.25B active: same structural family as
+        # mixtral_8x7b (8 experts, top-2, GQA, RoPE, SwiGLU) at
+        # one-chip scale. head_dim 128 keeps the MXU tile full.
+        cfg = llama_lib.mixtral_8x7b(
+            vocab_size=32768, dim=1024, n_layers=12, n_heads=8,
+            n_kv_heads=4, ffn_dim=2048, max_seq_len=seq_len,
+            remat_policy=args.remat_policy,
+            xent_chunk=args.xent_chunk,
+            attention_impl=args.attention_impl,
+            flash_block_q=args.flash_block_q,
+            flash_block_k=args.flash_block_k,
+        )
+    model = llama_lib.Llama(cfg)
+    params = llama_lib.init_params(
+        model, jax.random.PRNGKey(0), batch=1, seq=seq_len
+    )
+    n_params = _param_count(params)
+    # Active matmul params per token: total minus the input embedding
+    # gather minus the (n_experts - top_k) expert branches a token does
+    # NOT execute.
+    expert_params = (
+        cfg.n_layers * cfg.n_experts * 3 * cfg.dim * cfg.ffn_dim
+    )
+    inactive = expert_params * (cfg.n_experts - cfg.moe_top_k) // cfg.n_experts
+    embed_params = 0 if cfg.tie_embeddings else cfg.vocab_size * cfg.dim
+    active_params = n_params - embed_params - inactive
+    optimizer = optax.adamw(3e-4, mu_dtype=_mu_dtype(args))
+    opt_state = optimizer.init(params)
+    replicated = NamedSharding(mesh, P())
+    params = jax.device_put(params, replicated)
+    opt_state = jax.device_put(opt_state, replicated)
+
+    batch = args.moe_batch * n
+    tokens = shard_batch(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq_len)),
+        mesh,
+    )
+    step = jax.jit(
+        llama_lib.make_train_step(model, optimizer), donate_argnums=(0, 1)
+    )
+    log(f"compiling moe train step ({n_params / 1e6:.0f}M total / "
+        f"{active_params / 1e6:.0f}M active params, {cfg.n_experts} experts "
+        f"top-{cfg.moe_top_k}, batch {batch} x seq {seq_len})...")
+    with mesh:
+        (_, _, loss), sec = _timed_steps_maybe_profiled(
+            lambda p, o, l_, t: step(p, o, t),
+            (params, opt_state, None), (tokens,),
+            args,
+        )
+
+    tokens_per_sec = batch * seq_len / sec / n
+    flops_tok = (6 * active_params
+                 + 6 * cfg.n_layers * cfg.dim * seq_len)  # causal attn
+    tflops = flops_tok * tokens_per_sec / 1e12
+    peak, kind = peak_tflops()
+    log(
+        f"moe-{n_params / 1e6:.0f}M-a{active_params / 1e6:.0f}M: "
+        f"{tokens_per_sec:.0f} tok/s/chip, {sec * 1000:.1f} ms/step, "
+        f"loss {float(loss):.3f}, ~{tflops:.1f} TFLOP/s/chip active "
+        f"(~{100 * tflops / peak:.1f}% of {kind} bf16 peak)"
+    )
+    return {
+        "metric": "moe_mixtral_style_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": f"tokens({seq_len})/sec/chip",
+        # Active-FLOPs MFU fraction (no reference baseline exists).
+        "vs_baseline": round(tflops / peak, 3),
+        "config": _resolved_config(
+            args,
+            attention_impl=cfg.attention_impl,
+            flash_block_q=cfg.flash_block_q,
+            flash_block_k=cfg.flash_block_k,
+            xent_chunk=cfg.xent_chunk,
+            remat_policy=cfg.remat_policy if cfg.remat else "none",
+            moe_batch=args.moe_batch,
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Decode (serving-side throughput; static-KV-cache autoregressive path)
 # ---------------------------------------------------------------------------
 
@@ -919,6 +1037,7 @@ SUITES = {
     "bert": bench_bert,
     "llama": bench_llama,
     "vit": bench_vit,
+    "moe": bench_moe,
     "decode": bench_decode,
     "startup": bench_startup,
     "operator-scale": bench_operator_scale,
@@ -1118,6 +1237,15 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--scale-jobs", type=int, default=200,
                         help="operator-scale suite: size of the TPUJob "
                              "creation storm")
+    parser.add_argument("--moe-batch", type=int, default=8,
+                        help="moe suite: per-chip batch. 8 measured "
+                             "best on v5e (38,239 vs 36,520 tok/s at 4 "
+                             "- expert matmul rows grow with batch; "
+                             "fits 16G because MoE activations are "
+                             "capacity-bound, unlike the dense llama)")
+    parser.add_argument("--moe-tiny", action="store_true",
+                        help="moe suite: toy widths for the CPU "
+                             "contract test")
     parser.add_argument("--vit-batch", type=int, default=128,
                         help="vit suite: per-chip batch")
     parser.add_argument("--vit-remat", action="store_true",

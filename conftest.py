@@ -19,6 +19,24 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Compilation cache, scoped to THIS pytest session: many tests jit
+# byte-identical tiny programs through distinct wrappers (each a fresh
+# in-memory cache miss); the content-addressed disk cache dedupes them
+# within the run (measured: full default kernel tier 20:21 -> 17:29;
+# test_llama_pp subset 88s -> 52s). Deliberately NOT persisted across
+# runs: a shared long-lived cache made one warm full-tier run die with
+# a fatal interpreter error (unreproducible in isolation — see
+# docs/round5-notes.md), and a flaky proof surface is worse than a
+# slower one. Set through the config API — the env var is already
+# latched by sitecustomize's jax import (same trap as JAX_PLATFORMS).
+import atexit
+import shutil
+import tempfile
+
+_cache_dir = tempfile.mkdtemp(prefix="jax_cache_pytest_")
+atexit.register(shutil.rmtree, _cache_dir, ignore_errors=True)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 assert jax.devices()[0].platform == "cpu", (
     "tests must run on the virtual CPU platform, got "
     f"{jax.devices()[0].platform!r}"

@@ -77,6 +77,26 @@ class TestOperatorScaleSuite:
         assert float(m.group(1)) <= 12.0, out.stderr[-500:]
 
 
+class TestMoeSuite:
+    def test_tiny_moe_reports_contract(self):
+        """Full moe-suite path (GShard dispatch, aux-loss train step,
+        active-params MFU accounting) at toy widths on CPU."""
+        out = _run([
+            "--suite", "moe", "--moe-tiny", "--moe-batch", "2",
+            "--seq-len", "64", "--steps", "3", "--warmup", "1",
+        ])
+        assert out.returncode == 0, out.stderr[-800:] or out.stdout[-800:]
+        line = json.loads(out.stdout.strip().splitlines()[-1])
+        assert line["metric"] == "moe_mixtral_style_tokens_per_sec_per_chip"
+        assert line["value"] > 0
+        assert line["vs_baseline"] >= 0
+        # The resolved-config key must record what actually ran: the
+        # tiny path clamps the tiles to 64.
+        assert line["config"]["flash_block_q"] == 64
+        # Active-params accounting is logged for the sparsity ratio.
+        assert "active params" in out.stderr
+
+
 class TestDecodeSuite:
     def test_tiny_decode_reports_contract(self):
         """Full decode-suite path (compile two scan lengths, diff-

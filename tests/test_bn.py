@@ -183,6 +183,7 @@ class TestTpuBatchNormModule:
 
 
 class TestResnetWithPallasBN:
+    @pytest.mark.deep
     def test_resnet18_trains_and_matches_xla_bn(self):
         """Two-step training with bn_impl=pallas vs xla on identical
         inputs: losses must agree to bf16-accumulation tolerance."""

@@ -118,6 +118,7 @@ class TestEvalCli:
 
 
 class TestEvalMultiProcess:
+    @pytest.mark.e2e
     def test_two_process_eval_matches_single(self, capsys, tmp_path):
         """Two real subprocesses over jax.distributed (CPU backend, one
         device each) run cmd.eval --mesh dp=2 against a shared

@@ -336,6 +336,7 @@ class TestGenerate:
 
 
 class TestGenerateMultiProcess:
+    @pytest.mark.e2e
     def test_two_process_decode_matches_single(self, capsys, tmp_path):
         """Two real subprocesses over jax.distributed (CPU backend, one
         device each) run cmd.generate --mesh dp=2: tokens must match the

@@ -106,6 +106,7 @@ class TestPipelinedLlama:
             l_pp = float(jax.jit(loss_fn)(pp_params, shard_batch(tokens, mesh)))
         np.testing.assert_allclose(l_plain, l_pp, rtol=1e-5)
 
+    @pytest.mark.deep
     def test_fsdp_pp_gradients_match_plain(self, setup):
         """The all_gather's AD transpose (reduce-scatter) must yield the
         plain model's gradients exactly — a mis-scaled transpose would
@@ -147,6 +148,7 @@ class TestPipelinedLlama:
             l_pp = float(jax.jit(loss_fn)(pp_params, shard_batch(tokens, mesh)))
         np.testing.assert_allclose(l_plain, l_pp, rtol=1e-5)
 
+    @pytest.mark.deep
     def test_tp_fsdp_pp_gradients_match_plain(self, setup):
         """All three weight shardings at once — ZeRO-3 manual gather,
         tp auto, pp stages: gradients must still equal the plain
@@ -171,6 +173,7 @@ class TestPipelinedLlama:
                 np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-4
             )
 
+    @pytest.mark.deep
     def test_tp_pp_train_step_learns(self, setup):
         cfg, model, params, tokens = setup
         mesh = create_mesh(dp=2, tp=2, pp=2)
@@ -209,6 +212,7 @@ class TestPipelinedLlama:
             ))
         np.testing.assert_allclose(l_plain, l_pp, rtol=1e-4)
 
+    @pytest.mark.deep
     def test_sp_tp_pp_gradients_match_plain(self, setup):
         """Ring over manual sp, tp auto, pp stages — gradients equal the
         plain model's (the ring's custom VJP composes with the pipeline
@@ -290,6 +294,7 @@ class TestPipelinedLlama:
             ))
         np.testing.assert_allclose(l_pp, l_plain, rtol=1e-5)
 
+    @pytest.mark.deep
     def test_moe_pp_gradients_match_plain(self):
         cfg = llama_lib.tiny_moe(n_layers=4)
         model = llama_lib.Llama(cfg)
@@ -372,6 +377,7 @@ class TestPipelinedLlama:
                 params_spec={"w": P("pp", None, "fsdp")},
             )
 
+    @pytest.mark.deep
     def test_fsdp_pp_train_step_learns(self, setup):
         cfg, model, params, tokens = setup
         mesh = create_mesh(dp=2, fsdp=2, pp=2)
@@ -461,6 +467,7 @@ class TestTrainerPP:
                 "--sequence-parallel", "ring", "--zigzag-ring",
             ])
 
+    @pytest.mark.deep
     def test_pp_trains_from_token_file(self, capsys, tmp_path):
         """Real-corpus training through the pipeline: the Feistel token
         stream feeds the pp step a fresh batch every step."""

@@ -112,7 +112,10 @@ class TestSpaceToDepthStem:
 
 
 class TestGraftEntry:
+    @pytest.mark.e2e
     def test_dryrun_multichip(self):
+        # The full 8-config dryrun in a subprocess (~3 min on 1 CPU) —
+        # e2e tier; the driver also runs it directly every round.
         import __graft_entry__
 
         __graft_entry__.dryrun_multichip(8)
@@ -192,6 +195,7 @@ class TestScannedStages:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.deep
     def test_train_step_learns_scanned(self):
         import optax
 

@@ -10,6 +10,7 @@ CPU mesh.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import optax
 
 from mpi_operator_tpu.models import llama as llama_lib
@@ -191,6 +192,7 @@ class TestLlamaMoE:
 
 
 class TestExpertParallel:
+    @pytest.mark.deep
     def test_ep_sharded_train_step(self):
         """dp=2 × ep=2 × tp=2 mesh: expert weights shard over ep, the
         dispatch einsum crosses dp→ep (XLA's all-to-all moment), and the

@@ -276,6 +276,7 @@ class TestFlashAttentionBshd:
 
 
 class TestBlockSizeInvariance:
+    @pytest.mark.deep
     def test_nondefault_tiles_change_nothing(self):
         """block_q/block_k are a pure scheduling knob (the bench's MFU
         tuning surface) — outputs must be identical across tile sizes,

@@ -44,6 +44,7 @@ class TestSeq2Seq:
         ).apply({"params": params}, src, tgt)
         np.testing.assert_allclose(flash, dense, atol=1e-5, rtol=1e-5)
 
+    @pytest.mark.deep
     def test_flash_gradients_match_dense(self):
         cfg = s2s.tiny()
         src, tgt = _batch(cfg)

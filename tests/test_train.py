@@ -50,6 +50,7 @@ class TestTrainModels:
         )
         assert m["final_step"] == 3
 
+    @pytest.mark.deep
     def test_llama_tiny_on_4axis_mesh(self, capsys):
         m = run_train(
             capsys, "--model", "llama-tiny", "--steps", "3", "--warmup", "1",
@@ -265,6 +266,7 @@ class TestMeshGuards:
 
 
 class TestCheckpointResume:
+    @pytest.mark.deep
     def test_resume_continues_to_absolute_target(self, capsys, tmp_path):
         ckpt = str(tmp_path / "ckpt")
         base = [
@@ -367,6 +369,7 @@ class TestCheckpointResume:
         l0 = jax.tree_util.tree_leaves(like["params"]["blocks"])[0]
         assert g0.sharding == l0.sharding
 
+    @pytest.mark.deep
     def test_resume_onto_resized_pipeline(self, capsys, tmp_path):
         """Train at pp=4, checkpoint, resume at pp=2 (a preempted slice
         rarely comes back the same shape): the run continues instead of
@@ -396,6 +399,7 @@ class TestCheckpointResume:
         assert np.isfinite(resumed["loss"])
         assert resumed["loss"] == pytest.approx(straight["loss"], rel=1e-2)
 
+    @pytest.mark.deep
     def test_resume_onto_different_mesh(self, capsys, tmp_path):
         # Elastic resize end to end: save on dp=8, resume on dp=4,fsdp=2
         # with a raised absolute target.
