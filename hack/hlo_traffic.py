@@ -4,8 +4,17 @@
 Compiles the exact bench.py-shaped train programs for a v5e (via
 ``jax.experimental.topologies`` — no chip needed), then reports:
 
-- XLA cost-model FLOPs / bytes accessed and the MXU/HBM roofline floors
-  (v5e: 197 bf16 TFLOP/s, 819 GB/s), and
+- XLA cost-model FLOPs / bytes accessed and the MXU/HBM roofline
+  estimates (v5e: 197 bf16 TFLOP/s, 819 GB/s). CALIBRATION CAVEAT
+  (r5 hardware): ``bytes accessed`` sums every op's operands/outputs
+  and ignores fusion, so the derived "HBM floor" is NOT a floor — the
+  measured vit-b/16 fb256 step (115.9 ms) beat the tool's 136 ms
+  "floor", and the same over-count drove the wrong b256-amortization
+  prediction (modeled 59%, measured 39.2% — PERF.md). It also counts
+  NONE of the pallas kernels' internal traffic (under-count, the other
+  direction). Use the movement census and A/B DELTAS between two
+  programs of the same family — those difference out both biases; do
+  not read the absolute floors as bounds. And:
 - a census of pure data-movement ops (copy / copy-start / copy-done /
   transpose / bitcast-convert) by output bytes — the instrument that
   localized round 3's 12.5 GB/step of layout copies around the
