@@ -38,6 +38,8 @@ class BertConfig:
     attention_impl: str = "flash"
     # Flash kernel tile sizes (bench.py --flash-block-q/-k analog for
     # the BERT suite) — pure scheduling knobs, outputs are invariant.
+    # 128 is safe for any seq; 256 measured best at bench scale on v5e
+    # (TUNE_CAPTURE r5: 54.0% vs 38.8% MFU) — bench.py defaults to 256.
     flash_block_q: int = 128
     flash_block_k: int = 128
     # Per-layer jax.checkpoint: BERT-base activations fit HBM at the

@@ -58,8 +58,11 @@ class LlamaConfig:
     # head count). 'ring'/'ulysses' require a mesh.
     attention_impl: str = "flash"
     # Flash kernel tile sizes — the on-hardware MFU tuning surface
-    # (bench.py --flash-block-q/-k). 128 matches the MXU/lane shape;
-    # longer sequences sometimes prefer 256/512 on the k side.
+    # (bench.py --flash-block-q/-k). 128 matches the MXU/lane shape and
+    # is safe for any seq; at training scale 256/256 measured best on
+    # v5e for llama/bert/vit alike (larger q-tiles divide the kernel's
+    # internal k/v re-read; 512 exceeds the 16M scoped-vmem limit in
+    # the backward kernel — TUNE_CAPTURE r5). bench.py defaults to 256.
     flash_block_q: int = 128
     flash_block_k: int = 128
     # With ring attention: lay the sequence out zigzag (device i holds

@@ -46,6 +46,8 @@ class ViTConfig:
     dtype: Any = jnp.bfloat16
     # 'flash' (projection-layout pallas kernel) or 'dense' (XLA oracle).
     attention_impl: str = "flash"
+    # 128 is safe everywhere; 256 measured best at bench scale on v5e
+    # (TUNE_CAPTURE r5) — bench.py defaults to 256.
     flash_block_q: int = 128
     flash_block_k: int = 128
     # Per-layer jax.checkpoint for large-batch sweeps.
