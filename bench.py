@@ -13,9 +13,10 @@ Default run (what the driver executes) benchmarks ResNet-101 and prints
 exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Other suites: --suite bert | llama | vit | moe | decode | startup |
-operator-scale | all  (each prints its own single JSON line; `all`
-prints the headline line last and writes every result to PERF.md).
+Other suites: --suite bert | llama | vit | moe | seq2seq | decode |
+startup | operator-scale | all  (each prints its own single JSON line;
+`all` prints the headline line last and writes every result to
+PERF.md).
 """
 
 from __future__ import annotations
@@ -727,6 +728,121 @@ def bench_moe(args) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Seq2seq (fifth transformer family: encoder-decoder with cross-attention)
+# ---------------------------------------------------------------------------
+
+
+def bench_seq2seq(args) -> dict:
+    """Encoder-decoder training throughput (models/seq2seq: pre-norm
+    T5-style structure, flat flash kernels incl. the non-causal
+    cross-attention path). Sized to a ~450M t5-large-ish shape so
+    params + adamw state fit one v5e chip. MFU counts matmul params
+    per side (encoder params x src tokens, decoder params x dec
+    tokens) plus the three attention families (encoder self,
+    causal decoder self, dec x src cross)."""
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpi_operator_tpu.models import seq2seq as s2s_lib
+    from mpi_operator_tpu.parallel import create_mesh, shard_batch
+
+    n = len(jax.devices())
+    mesh = create_mesh(dp=-1)
+    seq = args.seq_len or 512  # src and dec length
+    if args.seq2seq_tiny:
+        # max_seq_len must cover the run's seq or the position-table
+        # gather silently clamps (same reason tiny_moe pins it).
+        cfg = s2s_lib.tiny(
+            attention_impl="flash", max_seq_len=max(seq, 64),
+            flash_block_q=min(args.flash_block_q, 32),
+            flash_block_k=min(args.flash_block_k, 32),
+        )
+    else:
+        cfg = s2s_lib.t5_small_shape(
+            dim=1024, n_enc_layers=12, n_dec_layers=12, n_heads=16,
+            ffn_dim=4096, max_seq_len=seq,
+            attention_impl=args.attention_impl,
+            flash_block_q=args.flash_block_q,
+            flash_block_k=args.flash_block_k,
+        )
+    model = s2s_lib.Seq2Seq(cfg)
+    params = s2s_lib.init_params(
+        model, jax.random.PRNGKey(0), batch=1, src=seq, dec=seq
+    )
+    n_params = _param_count(params)
+    optimizer = optax.adamw(3e-4, mu_dtype=_mu_dtype(args))
+    opt_state = optimizer.init(params)
+    replicated = NamedSharding(mesh, P())
+    params = jax.device_put(params, replicated)
+    opt_state = jax.device_put(opt_state, replicated)
+
+    batch = args.seq2seq_batch * n
+    rng = np.random.RandomState(0)
+    src = shard_batch(rng.randint(0, cfg.vocab_size, (batch, seq)), mesh)
+    tgt = shard_batch(rng.randint(0, cfg.vocab_size, (batch, seq)), mesh)
+    step = jax.jit(
+        s2s_lib.make_train_step(model, optimizer), donate_argnums=(0, 1)
+    )
+    log(f"compiling seq2seq train step ({n_params / 1e6:.0f}M params, "
+        f"batch {batch} x src {seq} x dec {seq})...")
+    with mesh:
+        (_, _, loss), sec = _timed_steps_maybe_profiled(
+            lambda p, o, l_, s, t: step(p, o, s, t),
+            (params, opt_state, None), (src, tgt),
+            args,
+        )
+
+    pairs_per_sec = batch / sec / n
+    # Matmul params per side. The shared embed table is TIED to the
+    # logits head (seq2seq.py: f32_logits(dec, embed.T)) — same
+    # convention as tied llama (bench_llama): the table IS the head
+    # matmul, so it stays in the count, attributed to the decoder side
+    # (the head consumes dec tokens; the enc/dec gathers are not
+    # matmuls but the table is only counted once).
+    d, L_e, L_d = cfg.dim, cfg.n_enc_layers, cfg.n_dec_layers
+    enc_params = L_e * (4 * d * d + 2 * d * cfg.ffn_dim)
+    dec_params = n_params - enc_params
+    # fwd+bwd matmuls: 6 x params x tokens; attention score/value
+    # matmuls: 12·B·S²·d per non-causal self layer (halved causal),
+    # cross gets S_dec x S_src.
+    flops_step = (
+        6 * enc_params * batch * seq
+        + 6 * dec_params * batch * seq
+        + 12 * L_e * batch * seq * seq * d        # encoder self
+        + 6 * L_d * batch * seq * seq * d         # causal decoder self
+        + 12 * L_d * batch * seq * seq * d        # cross dec x src
+    )
+    # flops_step covers the global batch; divide by batch for per-pair
+    # then multiply by per-chip pairs/s -> per-chip FLOP/s (no extra
+    # device factor — pairs_per_sec is already per chip).
+    tflops = flops_step / batch * pairs_per_sec / 1e12
+    peak, kind = peak_tflops()
+    log(
+        f"seq2seq-{n_params / 1e6:.0f}M: {pairs_per_sec:.1f} pairs/s/chip, "
+        f"{sec * 1000:.1f} ms/step, loss {float(loss):.3f}, "
+        f"~{tflops:.1f} TFLOP/s/chip "
+        f"(~{100 * tflops / peak:.1f}% of {kind} bf16 peak)"
+    )
+    return {
+        "metric": "seq2seq_t5large_pairs_per_sec_per_chip",
+        "value": round(pairs_per_sec, 2),
+        "unit": f"pairs(src{seq}/dec{seq})/sec/chip",
+        "vs_baseline": round(tflops / peak, 3),
+        "config": _resolved_config(
+            args,
+            attention_impl=cfg.attention_impl,
+            flash_block_q=cfg.flash_block_q,
+            flash_block_k=cfg.flash_block_k,
+            xent_chunk=0,
+            remat_policy="none",
+            seq2seq_batch=args.seq2seq_batch,
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Decode (serving-side throughput; static-KV-cache autoregressive path)
 # ---------------------------------------------------------------------------
 
@@ -1038,6 +1154,7 @@ SUITES = {
     "llama": bench_llama,
     "vit": bench_vit,
     "moe": bench_moe,
+    "seq2seq": bench_seq2seq,
     "decode": bench_decode,
     "startup": bench_startup,
     "operator-scale": bench_operator_scale,
@@ -1245,6 +1362,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "capacity-bound, unlike the dense llama)")
     parser.add_argument("--moe-tiny", action="store_true",
                         help="moe suite: toy widths for the CPU "
+                             "contract test")
+    parser.add_argument("--seq2seq-batch", type=int, default=16,
+                        help="seq2seq suite: per-chip batch of "
+                             "src/dec pairs")
+    parser.add_argument("--seq2seq-tiny", action="store_true",
+                        help="seq2seq suite: toy widths for the CPU "
                              "contract test")
     parser.add_argument("--vit-batch", type=int, default=128,
                         help="vit suite: per-chip batch")

@@ -53,6 +53,7 @@ run bert-base          --suite bert --profile-dir /tmp/trace-bert
 run llama-0p7b         --suite llama --profile-dir /tmp/trace-llama
 run vit-b16            --suite vit --profile-dir /tmp/trace-vit
 run moe-0p7b-a0p25     --suite moe --profile-dir /tmp/trace-moe
+run seq2seq-t5large    --suite seq2seq
 run startup            --suite startup
 run decode             --suite decode
 # Kernel-vs-compiler A/Bs (each isolates one hypothesis from the

@@ -97,6 +97,21 @@ class TestMoeSuite:
         assert "active params" in out.stderr
 
 
+class TestSeq2SeqSuite:
+    def test_tiny_seq2seq_reports_contract(self):
+        """Full seq2seq-suite path (encoder-decoder train step with
+        cross-attention, per-side FLOP accounting) at toy widths."""
+        out = _run([
+            "--suite", "seq2seq", "--seq2seq-tiny", "--seq2seq-batch", "2",
+            "--seq-len", "32", "--steps", "3", "--warmup", "1",
+        ])
+        assert out.returncode == 0, out.stderr[-800:] or out.stdout[-800:]
+        line = json.loads(out.stdout.strip().splitlines()[-1])
+        assert line["metric"] == "seq2seq_t5large_pairs_per_sec_per_chip"
+        assert line["value"] > 0
+        assert line["config"]["flash_block_q"] == 32  # tiny-path clamp
+
+
 class TestDecodeSuite:
     def test_tiny_decode_reports_contract(self):
         """Full decode-suite path (compile two scan lengths, diff-
