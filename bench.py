@@ -654,6 +654,7 @@ def bench_moe(args) -> dict:
         cfg = llama_lib.mixtral_8x7b(
             vocab_size=32768, dim=1024, n_layers=12, n_heads=8,
             n_kv_heads=4, ffn_dim=2048, max_seq_len=seq_len,
+            capacity_factor=args.moe_capacity_factor,
             remat_policy=args.remat_policy,
             xent_chunk=args.xent_chunk,
             attention_impl=args.attention_impl,
@@ -723,6 +724,7 @@ def bench_moe(args) -> dict:
             xent_chunk=cfg.xent_chunk,
             remat_policy=cfg.remat_policy if cfg.remat else "none",
             moe_batch=args.moe_batch,
+            moe_capacity_factor=cfg.capacity_factor,
         ),
     }
 
@@ -735,8 +737,9 @@ def bench_moe(args) -> dict:
 def bench_seq2seq(args) -> dict:
     """Encoder-decoder training throughput (models/seq2seq: pre-norm
     T5-style structure, flat flash kernels incl. the non-causal
-    cross-attention path). Sized to a ~450M t5-large-ish shape so
-    params + adamw state fit one v5e chip. MFU counts matmul params
+    cross-attention path). Sized to a ~386M t5-large-ish shape (embed
+    33M + enc 151M + dec-with-cross 201M, tied head) so params + adamw
+    state fit one v5e chip. MFU counts matmul params
     per side (encoder params x src tokens, decoder params x dec
     tokens) plus the three attention families (encoder self,
     causal decoder self, dec x src cross)."""
@@ -1363,6 +1366,13 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--moe-tiny", action="store_true",
                         help="moe suite: toy widths for the CPU "
                              "contract test")
+    parser.add_argument("--moe-capacity-factor", type=float, default=1.25,
+                        help="moe suite: expert capacity factor. Every "
+                             "E x C slot computes whether filled or "
+                             "not, so executed expert rows/token = "
+                             "top_k x cf - lower cf trades drops for "
+                             "throughput (a quality knob, so it is a "
+                             "sweep point, not a default)")
     parser.add_argument("--seq2seq-batch", type=int, default=16,
                         help="seq2seq suite: per-chip batch of "
                              "src/dec pairs")

@@ -53,7 +53,11 @@ run bert-base          --suite bert --profile-dir /tmp/trace-bert
 run llama-0p7b         --suite llama --profile-dir /tmp/trace-llama
 run vit-b16            --suite vit --profile-dir /tmp/trace-vit
 run moe-0p7b-a0p25     --suite moe --profile-dir /tmp/trace-moe
-run seq2seq-t5large    --suite seq2seq
+# Capacity-factor A/B (r5: cf 1.0 = +15.9% tok/s over the 1.25
+# default - every E x C slot computes whether filled; cf stays a
+# quality knob, this line keeps the padding cost visible run to run).
+run moe-b8-cf1.0       --suite moe --moe-capacity-factor 1.0
+run seq2seq-t5large    --suite seq2seq --profile-dir /tmp/trace-seq2seq
 run startup            --suite startup
 run decode             --suite decode
 # Kernel-vs-compiler A/Bs (each isolates one hypothesis from the
