@@ -1,0 +1,337 @@
+"""Hardware probe for the head-dim-64 kernel tax (PERF.md round-5).
+
+The round-5 winner traces measured the flat pallas attention kernels at
+~33.6% of BERT's device time and ~25% of ViT's — against llama's 12.1%
+— at roughly 8-10% of FLOPs. Both families run 64-wide heads; llama
+runs 128. Two candidate mechanisms, both fixed by the same kernel
+layout change:
+
+  (a) the in-kernel head loop slices operand lanes at 64-element
+      offsets (``ref[:, hh*64:(hh+1)*64]``) — every ODD head starts at
+      lane 64, an unaligned lane slice Mosaic must realign before the
+      MXU can consume it;
+  (b) each per-head matmul is half-width on the 128-lane MXU
+      (contraction 64 for q·kᵀ, output 64 for p·v), and tile padding
+      burns the other half.
+
+The PACKED layout processes pack = 128//d heads per iteration:
+  - q/k/v pair slices are ``[:, p*128:(p+1)*128]`` — always aligned;
+  - k and v are expanded to BLOCK-DIAGONAL ``[pack*block_k, 128]``
+    tiles via lane masks (cheap VPU selects, no shifts), so
+    q·kbdᵀ = [s_h0 | s_h1] in one full-width (K=128) matmul and
+    p·vbd accumulates both heads' outputs in one full-width (N=128)
+    matmul. Tile arithmetic says MXU cycles are EQUAL either way
+    (zeros in the block-diag buy exactly the tiles padding wasted), so
+    any measured win is the realignment + per-op overhead — which is
+    why this needs a hardware A/B, not a model.
+
+Usage (never under a killable timeout — a killed client can wedge the
+tunnel, see PERF.md):
+
+    python hack/headdim_probe.py bert   # b=64 x s=512, h=12 d=64, fb256
+    python hack/headdim_probe.py vit    # b=128 x s=196, h=12 d=64, fb256
+    python hack/headdim_probe.py dots   # raw matmul ladder (cost model)
+
+Prints one line per variant: ms/call and TFLOP/s, plus max|Δ| vs the
+current kernel. PROBE_OK on completion.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mpi_operator_tpu.ops.attention import (  # noqa: E402
+    NEG_INF, _block_mask, _flash_flat_fwd_impl, _pad_to,
+)
+
+
+# --------------------------------------------------------------------------
+# Packed-pair forward kernel prototype (pack = 128 // d heads per block)
+# --------------------------------------------------------------------------
+
+
+def _fwd_packed_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, sm_scale, causal, q_len, kv_len, block_q, block_k, h, d, pack,
+):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    npair = h // pack
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    mask, live = _block_mask(
+        i, j, None, None, causal=causal, q_len=q_len, kv_len=kv_len,
+        block_q=block_q, block_k=block_k,
+    )
+    # Lane coordinate of a [block_k, 128] k/v tile and of a
+    # [block_q, 128] output tile; slot t owns lanes [t*d, (t+1)*d).
+    lane_k = jax.lax.broadcasted_iota(jnp.int32, (block_k, 128), 1)
+    lane_q = jax.lax.broadcasted_iota(jnp.int32, (block_q, 128), 1)
+    if mask is not None:
+        maskw = jnp.concatenate([mask] * pack, axis=1)
+
+    def _lane_select(per_slot):
+        """[bq,1] per slot -> [bq,128] with slot t's value on its lanes."""
+        out = jnp.broadcast_to(per_slot[0], (block_q, 128))
+        for t in range(1, pack):
+            out = jnp.where(lane_q >= t * d,
+                            jnp.broadcast_to(per_slot[t], (block_q, 128)),
+                            out)
+        return out
+
+    def compute():
+        for p in range(npair):
+            qp = q_ref[0][:, p * 128:(p + 1) * 128]
+            kp = k_ref[0][:, p * 128:(p + 1) * 128]
+            vp = v_ref[0][:, p * 128:(p + 1) * 128]
+            kbd = jnp.concatenate(
+                [jnp.where((lane_k >= t * d) & (lane_k < (t + 1) * d),
+                           kp, jnp.zeros_like(kp))
+                 for t in range(pack)], axis=0)
+            vbd = jnp.concatenate(
+                [jnp.where((lane_k >= t * d) & (lane_k < (t + 1) * d),
+                           vp, jnp.zeros_like(vp))
+                 for t in range(pack)], axis=0)
+            s = jax.lax.dot_general(
+                qp, kbd, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale                                  # [bq, pack*bk]
+            if mask is not None:
+                s_masked = jnp.where(maskw, s, NEG_INF)
+            else:
+                s_masked = s
+            corr_slots, p_cols = [], []
+            for t in range(pack):
+                hh = p * pack + t
+                st = s_masked[:, t * block_k:(t + 1) * block_k]
+                m_prev = m_ref[:, hh:hh + 1]
+                l_prev = l_ref[:, hh:hh + 1]
+                m_cur = jnp.max(st, axis=1, keepdims=True)
+                m_new = jnp.maximum(m_prev, m_cur)
+                # Re-mask after the subtraction (same as _fwd_flat_kernel):
+                # a row whose running max is still NEG_INF must produce
+                # pt=0, not exp(0)=1, or dead rows defeat the l>0 guard.
+                if mask is not None:
+                    pt = jnp.exp(jnp.where(mask, st - m_new, NEG_INF))
+                else:
+                    pt = jnp.exp(st - m_new)
+                corr = jnp.exp(m_prev - m_new)
+                l_ref[:, hh:hh + 1] = (
+                    corr * l_prev + jnp.sum(pt, axis=1, keepdims=True)
+                )
+                m_ref[:, hh:hh + 1] = m_new
+                corr_slots.append(corr)
+                p_cols.append(pt)
+            p_mat = jnp.concatenate(p_cols, axis=1)        # [bq, pack*bk]
+            corr_bcast = _lane_select(corr_slots)          # [bq, 128]
+            acc_ref[p] = acc_ref[p] * corr_bcast + jax.lax.dot(
+                p_mat.astype(v_ref.dtype), vbd,
+                preferred_element_type=jnp.float32,
+            )
+
+    if live is None:
+        compute()
+    else:
+        pl.when(live)(compute)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        for p in range(npair):
+            l_slots = [l_ref[:, p * pack + t:p * pack + t + 1]
+                       for t in range(pack)]
+            safe = [jnp.where(l > 0.0, l, 1.0) for l in l_slots]
+            l_bcast = _lane_select(safe)
+            o_ref[0, :, p * 128:(p + 1) * 128] = (
+                acc_ref[p] / l_bcast
+            ).astype(o_ref.dtype)
+            for t in range(pack):
+                hh = p * pack + t
+                l = l_slots[t]
+                safe_l = safe[t]
+                lse_ref[0, :, hh:hh + 1] = jnp.where(
+                    l > 0.0, m_ref[:, hh:hh + 1] + jnp.log(safe_l), NEG_INF
+                )
+
+
+def flash_packed_fwd(qf, kf, vf, h, sm_scale, causal, block_q, block_k,
+                     interpret=False):
+    b, q_len, hd_total = qf.shape
+    d = hd_total // h
+    assert d <= 128 and 128 % d == 0, f"head dim {d} must divide 128"
+    pack = 128 // d
+    assert h % pack == 0 and kf.shape[-1] == hd_total, "MHA, h % pack == 0"
+    kv_len = kf.shape[1]
+    qp = _pad_to(qf, 1, block_q)
+    kp = _pad_to(kf, 1, block_k)
+    vp = _pad_to(vf, 1, block_k)
+    nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
+    npair = h // pack
+    kernel = functools.partial(
+        _fwd_packed_kernel,
+        sm_scale=sm_scale, causal=causal, q_len=q_len, kv_len=kv_len,
+        block_q=block_q, block_k=block_k, h=h, d=d, pack=pack,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, h * d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, h * d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, h * d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, h * d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qp.shape, qf.dtype),
+            jax.ShapeDtypeStruct((b, qp.shape[1], h), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((npair, block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :q_len], lse[:, :q_len]
+
+
+# --------------------------------------------------------------------------
+# Harness
+# --------------------------------------------------------------------------
+
+
+def _timed(fn, *args, steps=20):
+    """Two-window difference quotient with readback barrier (PERF.md)."""
+    out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    n1 = max(steps // 4, 1)
+    t0 = time.perf_counter()
+    for _ in range(n1):
+        out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    t1 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    t2 = time.perf_counter()
+    sec = ((t2 - t1) - (t1 - t0)) / (steps - n1)
+    if sec <= 0:
+        sec = (t2 - t1) / steps
+    return out, sec
+
+
+def run_attn(shape_name: str) -> None:
+    # A single standalone kernel dispatch over the tunnel is launch-
+    # latency-bound (measured 1.7 TF/s at b=8 — nonsense vs the ~30 TF/s
+    # the same kernel shows inside the bench program), so each timed
+    # unit is ONE jitted program chaining REPS kernel calls through the
+    # carry (q_{n+1} = o_n, so nothing is loop-invariant and XLA cannot
+    # hoist the call).
+    REPS = 50
+    if shape_name == "bert":
+        b, s, h, d, causal = 64, 512, 12, 64, False
+    elif shape_name == "vit":
+        b, s, h, d, causal = 128, 196, 12, 64, False
+    else:
+        raise SystemExit(f"unknown shape {shape_name}")
+    bq = bk = 256
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    qf = jax.random.normal(kq, (b, s, h * d), jnp.bfloat16)
+    kf = jax.random.normal(kk, (b, s, h * d), jnp.bfloat16)
+    vf = jax.random.normal(kv, (b, s, h * d), jnp.bfloat16)
+    sm = 1.0 / (d ** 0.5)
+    flops = 4.0 * b * h * s * s * d * (0.5 if causal else 1.0)
+
+    def chained(kernel_fn):
+        @jax.jit
+        def f(q, k, v):
+            def body(carry, _):
+                o, lse = kernel_fn(carry, k, v)
+                return o.astype(carry.dtype), lse
+            o, lses = jax.lax.scan(body, q, None, length=REPS)
+            return o, lses[-1]
+        return f
+
+    cur = chained(lambda q, k, v: _flash_flat_fwd_impl(
+        q, k, v, None, None, h, sm, causal, bq, bk, False))
+    pkd = chained(lambda q, k, v: flash_packed_fwd(
+        q, k, v, h, sm, causal, bq, bk))
+
+    (o_cur, lse_cur), sec_cur = _timed(cur, qf, kf, vf, steps=5)
+    sec_cur /= REPS
+    print(f"  current flat fwd : {sec_cur*1e3:8.3f} ms  "
+          f"{flops/sec_cur/1e12:6.1f} TF/s", flush=True)
+    (o_pkd, lse_pkd), sec_pkd = _timed(pkd, qf, kf, vf, steps=5)
+    sec_pkd /= REPS
+    print(f"  packed-pair fwd  : {sec_pkd*1e3:8.3f} ms  "
+          f"{flops/sec_pkd/1e12:6.1f} TF/s", flush=True)
+    do = np.max(np.abs(np.asarray(o_cur, np.float32)
+                       - np.asarray(o_pkd, np.float32)))
+    dl = np.max(np.abs(np.asarray(lse_cur) - np.asarray(lse_pkd)))
+    print(f"  max|Δo| {do:.3e}  max|Δlse| {dl:.3e}  "
+          f"speedup {sec_cur/sec_pkd:5.2f}x", flush=True)
+
+
+def run_dots() -> None:
+    """Raw MXU cost model: is a K=64 (or N=64) matmul tile-padded?"""
+    bq = bk = 256
+
+    def ladder(label, m, k, n, reps):
+        a = jax.random.normal(jax.random.PRNGKey(1), (m, k), jnp.bfloat16)
+        bmat = jax.random.normal(jax.random.PRNGKey(2), (k, n), jnp.bfloat16)
+
+        @jax.jit
+        def f(a, bmat):
+            def body(c, _):
+                return c + jnp.dot(a, bmat,
+                                   preferred_element_type=jnp.float32), None
+            c0 = jnp.zeros((m, n), jnp.float32)
+            c, _ = jax.lax.scan(body, c0, None, length=reps)
+            return c
+
+        _, sec = _timed(f, a, bmat)
+        fl = 2.0 * m * k * n * reps
+        print(f"  {label:28s}: {sec*1e3:8.3f} ms  {fl/sec/1e12:6.1f} TF/s",
+              flush=True)
+
+    ladder(f"[{bq},64]x[64,{bk}] x256", bq, 64, bk, 256)
+    ladder(f"[{bq},128]x[128,{bk}] x256", bq, 128, bk, 256)
+    ladder(f"[{bq},512]x[512,64] x256", bq, 512, 64, 256)
+    ladder(f"[{bq},512]x[512,128] x256", bq, 512, 128, 256)
+
+
+def main() -> int:
+    what = sys.argv[1] if len(sys.argv) > 1 else "bert"
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind}", flush=True)
+    if what == "dots":
+        run_dots()
+    else:
+        run_attn(what)
+    print("PROBE_OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
