@@ -634,9 +634,75 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 # backward's delta = rowsum(do·o) a transpose-free reduction.
 
 
+def _flat_pack(h: int, d: int, groups: int) -> int:
+    """Heads packed per 128-lane block in the flat kernels' inner loops.
+
+    d == 64 (the bert/vit/seq2seq class) runs each per-head matmul at
+    half MXU width and slices every odd head's operands at an unaligned
+    64-lane offset that Mosaic must realign — measured 1.6-1.8x slower
+    per FLOP than the packed layout (hack/headdim_probe.py, hardware
+    A/B on a v5e, bit-identical outputs). Packing processes 128//d
+    heads per iteration on aligned [:, p*128:(p+1)*128] slices, with
+    k/v expanded to block-diagonal [pack*block_k, 128] tiles by lane
+    masks; tile arithmetic says MXU cycles are EQUAL either way (the
+    block-diagonal zeros buy exactly the tiles padding wasted), so the
+    whole win is alignment + fewer per-op overheads.
+
+    Requires MHA (groups == 1 — GQA's shared-kv arithmetic would need
+    per-slot kv indices) and h divisible by the pack width; everything
+    else — including the d == 128 llama class — keeps the plain
+    per-head loop (pack == 1, the exact round-4 code path).
+
+    MPI_OPERATOR_TPU_FLAT_PACK=0 disables packing (the hardware A/B
+    control; also the escape hatch if a geometry regresses).
+    """
+    if os.environ.get("MPI_OPERATOR_TPU_FLAT_PACK", "1") == "0":
+        return 1
+    if d < 128 and 128 % d == 0 and groups == 1:
+        pack = 128 // d
+        if h % pack == 0:
+            return pack
+    return 1
+
+
+def _bd_lane_tiles(xp, lane, d, pack):
+    """[block_k, 128] pair tile -> block-diagonal [pack*block_k, 128]:
+    piece t keeps lanes [t*d, (t+1)*d). Lane masks + a sublane concat —
+    no lane shifts anywhere (the point of the packed layout)."""
+    return jnp.concatenate(
+        [jnp.where((lane >= t * d) & (lane < (t + 1) * d), xp,
+                   jnp.zeros_like(xp))
+         for t in range(pack)], axis=0)
+
+
+def _lane_bcast(slots, lane, d):
+    """Per-slot [bq, 1] columns -> [bq, 128] with slot t's value
+    broadcast over its d lanes (pure selects, no shifts)."""
+    out = jnp.broadcast_to(slots[0], lane.shape)
+    for t in range(1, len(slots)):
+        out = jnp.where(lane >= t * d, jnp.broadcast_to(slots[t], lane.shape),
+                        out)
+    return out
+
+
+def _bd_combine(m, lane, d, pack, block_k):
+    """[pack*block_k, 128] block-diagonal-shaped matmul result ->
+    [block_k, 128] pair tile: slot t's row band keeps only its d lanes
+    (the other lanes hold cross-head garbage by construction)."""
+    out = None
+    for t in range(pack):
+        piece = jnp.where(
+            (lane >= t * d) & (lane < (t + 1) * d),
+            m[t * block_k:(t + 1) * block_k], jnp.zeros_like(lane, m.dtype),
+        )
+        out = piece if out is None else out + piece
+    return out
+
+
 def _fwd_flat_kernel(
     *refs,
     sm_scale, causal, use_ids, q_len, kv_len, block_q, block_k, h, d, groups,
+    pack,
 ):
     if use_ids:
         q_ref, k_ref, v_ref, row_ref, col_ref = refs[:5]
@@ -686,27 +752,85 @@ def _fwd_flat_kernel(
                 preferred_element_type=jnp.float32,
             )
 
+    def compute_packed():
+        lane_k = jax.lax.broadcasted_iota(jnp.int32, (block_k, 128), 1)
+        lane_q = jax.lax.broadcasted_iota(jnp.int32, (block_q, 128), 1)
+        for pi in range(h // pack):
+            qp = q_ref[0][:, pi * 128:(pi + 1) * 128]
+            kbd = _bd_lane_tiles(
+                k_ref[0][:, pi * 128:(pi + 1) * 128], lane_k, d, pack)
+            vbd = _bd_lane_tiles(
+                v_ref[0][:, pi * 128:(pi + 1) * 128], lane_k, d, pack)
+            # One full-width matmul: [bq,128]x[128,pack*bk] — columns of
+            # slot t see only q's slot-t lanes (kbd zeros kill the rest).
+            s = jax.lax.dot_general(
+                qp, kbd, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale
+            corr_slots, p_cols = [], []
+            for t in range(pack):
+                hh = pi * pack + t
+                st = s[:, t * block_k:(t + 1) * block_k]
+                m_prev, l_prev = m_ref[:, hh:hh + 1], l_ref[:, hh:hh + 1]
+                m_cur = jnp.max(jnp.where(mask, st, NEG_INF),
+                                axis=1, keepdims=True)
+                m_new = jnp.maximum(m_prev, m_cur)
+                pt = jnp.exp(jnp.where(mask, st - m_new, NEG_INF))
+                corr = jnp.exp(m_prev - m_new)
+                l_ref[:, hh:hh + 1] = (
+                    corr * l_prev + jnp.sum(pt, axis=1, keepdims=True)
+                )
+                m_ref[:, hh:hh + 1] = m_new
+                corr_slots.append(corr)
+                p_cols.append(pt)
+            p_mat = jnp.concatenate(p_cols, axis=1)
+            acc_ref[pi] = (
+                acc_ref[pi] * _lane_bcast(corr_slots, lane_q, d)
+                + jax.lax.dot(
+                    p_mat.astype(v_ref.dtype), vbd,
+                    preferred_element_type=jnp.float32,
+                )
+            )
+
+    body = compute if pack == 1 else compute_packed
     if live is None:
-        compute()
+        body()
     else:
-        pl.when(live)(compute)
+        pl.when(live)(body)
 
     @pl.when(j == nk - 1)
     def _finalize():
-        for hh in range(h):
-            l = l_ref[:, hh:hh + 1]
-            safe_l = jnp.where(l > 0.0, l, 1.0)
-            o_ref[0, :, hh * d:(hh + 1) * d] = (
-                acc_ref[hh] / safe_l
-            ).astype(o_ref.dtype)
-            lse_ref[0, :, hh:hh + 1] = jnp.where(
-                l > 0.0, m_ref[:, hh:hh + 1] + jnp.log(safe_l), NEG_INF
-            )
+        if pack == 1:
+            for hh in range(h):
+                l = l_ref[:, hh:hh + 1]
+                safe_l = jnp.where(l > 0.0, l, 1.0)
+                o_ref[0, :, hh * d:(hh + 1) * d] = (
+                    acc_ref[hh] / safe_l
+                ).astype(o_ref.dtype)
+                lse_ref[0, :, hh:hh + 1] = jnp.where(
+                    l > 0.0, m_ref[:, hh:hh + 1] + jnp.log(safe_l), NEG_INF
+                )
+        else:
+            lane_q = jax.lax.broadcasted_iota(jnp.int32, (block_q, 128), 1)
+            for pi in range(h // pack):
+                l_slots = [l_ref[:, pi * pack + t:pi * pack + t + 1]
+                           for t in range(pack)]
+                safe = [jnp.where(l > 0.0, l, 1.0) for l in l_slots]
+                o_ref[0, :, pi * 128:(pi + 1) * 128] = (
+                    acc_ref[pi] / _lane_bcast(safe, lane_q, d)
+                ).astype(o_ref.dtype)
+                for t in range(pack):
+                    hh = pi * pack + t
+                    lse_ref[0, :, hh:hh + 1] = jnp.where(
+                        l_slots[t] > 0.0,
+                        m_ref[:, hh:hh + 1] + jnp.log(safe[t]), NEG_INF,
+                    )
 
 
 def _bwd_flat_dq_kernel(
     *refs,
     sm_scale, causal, use_ids, q_len, kv_len, block_q, block_k, h, d, groups,
+    pack,
 ):
     if use_ids:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -748,22 +872,60 @@ def _bwd_flat_dq_kernel(
                 ds.astype(kh.dtype), kh, preferred_element_type=jnp.float32
             )
 
+    def compute_packed():
+        lane_k = jax.lax.broadcasted_iota(jnp.int32, (block_k, 128), 1)
+        for pi in range(h // pack):
+            qp = q_ref[0][:, pi * 128:(pi + 1) * 128]
+            dop = do_ref[0][:, pi * 128:(pi + 1) * 128]
+            kbd = _bd_lane_tiles(
+                k_ref[0][:, pi * 128:(pi + 1) * 128], lane_k, d, pack)
+            vbd = _bd_lane_tiles(
+                v_ref[0][:, pi * 128:(pi + 1) * 128], lane_k, d, pack)
+            s = jax.lax.dot_general(
+                qp, kbd, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                dop, vbd, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds_cols = []
+            for t in range(pack):
+                hh = pi * pack + t
+                st = s[:, t * block_k:(t + 1) * block_k]
+                pt = jnp.exp(jnp.where(
+                    mask, st * sm_scale - lse_ref[0][:, hh:hh + 1], NEG_INF))
+                ds_cols.append(pt * (dp[:, t * block_k:(t + 1) * block_k]
+                                     - delta_ref[0][:, hh:hh + 1]))
+            ds = jnp.concatenate(ds_cols, axis=1)
+            dq_acc_ref[pi] += sm_scale * jax.lax.dot(
+                ds.astype(kbd.dtype), kbd, preferred_element_type=jnp.float32
+            )
+
+    body = compute if pack == 1 else compute_packed
     if live is None:
-        compute()
+        body()
     else:
-        pl.when(live)(compute)
+        pl.when(live)(body)
 
     @pl.when(j == nk - 1)
     def _finalize():
-        for hh in range(h):
-            dq_ref[0, :, hh * d:(hh + 1) * d] = dq_acc_ref[hh].astype(
-                dq_ref.dtype
-            )
+        if pack == 1:
+            for hh in range(h):
+                dq_ref[0, :, hh * d:(hh + 1) * d] = dq_acc_ref[hh].astype(
+                    dq_ref.dtype
+                )
+        else:
+            for pi in range(h // pack):
+                dq_ref[0, :, pi * 128:(pi + 1) * 128] = dq_acc_ref[pi].astype(
+                    dq_ref.dtype
+                )
 
 
 def _bwd_flat_dkv_kernel(
     *refs,
     sm_scale, causal, use_ids, q_len, kv_len, block_q, block_k, h, d, groups,
+    pack,
 ):
     # Grid: (batch, k-blocks, q-blocks) — q innermost so dk/dv accumulate
     # in VMEM across the whole contraction; ALL query heads (including a
@@ -814,21 +976,75 @@ def _bwd_flat_dkv_kernel(
                 preferred_element_type=jnp.float32,
             )
 
+    def compute_packed():
+        lane_k = jax.lax.broadcasted_iota(jnp.int32, (block_k, 128), 1)
+        for pi in range(h // pack):
+            qp = q_ref[0][:, pi * 128:(pi + 1) * 128]
+            dop = do_ref[0][:, pi * 128:(pi + 1) * 128]
+            kbd = _bd_lane_tiles(
+                k_ref[0][:, pi * 128:(pi + 1) * 128], lane_k, d, pack)
+            vbd = _bd_lane_tiles(
+                v_ref[0][:, pi * 128:(pi + 1) * 128], lane_k, d, pack)
+            s = jax.lax.dot_general(
+                qp, kbd, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                dop, vbd, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            p_cols, ds_cols = [], []
+            for t in range(pack):
+                hh = pi * pack + t
+                st = s[:, t * block_k:(t + 1) * block_k]
+                pt = jnp.exp(jnp.where(
+                    mask, st * sm_scale - lse_ref[0][:, hh:hh + 1], NEG_INF))
+                p_cols.append(pt)
+                ds_cols.append(pt * (dp[:, t * block_k:(t + 1) * block_k]
+                                     - delta_ref[0][:, hh:hh + 1]))
+            p_mat = jnp.concatenate(p_cols, axis=1)
+            ds = jnp.concatenate(ds_cols, axis=1)
+            # [bq, pack*bk]^T x [bq, 128] -> [pack*bk, 128]: slot t's row
+            # band holds its dv/dk on its own d lanes and cross-head
+            # garbage elsewhere; _bd_combine masks the garbage and folds
+            # the bands into the [bk, 128] pair accumulator.
+            mv = jax.lax.dot_general(
+                p_mat.astype(dop.dtype), dop, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dv_acc_ref[pi] += _bd_combine(mv, lane_k, d, pack, block_k)
+            mk = jax.lax.dot_general(
+                ds.astype(qp.dtype), qp, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dk_acc_ref[pi] += sm_scale * _bd_combine(
+                mk, lane_k, d, pack, block_k)
+
+    body = compute if pack == 1 else compute_packed
     if live is None:
-        compute()
+        body()
     else:
-        pl.when(live)(compute)
+        pl.when(live)(body)
 
     @pl.when(i == ne - 1)
     def _finalize():
-        h_kv = h // groups
-        for hk in range(h_kv):
-            dk_ref[0, :, hk * d:(hk + 1) * d] = dk_acc_ref[hk].astype(
-                dk_ref.dtype
-            )
-            dv_ref[0, :, hk * d:(hk + 1) * d] = dv_acc_ref[hk].astype(
-                dv_ref.dtype
-            )
+        if pack == 1:
+            h_kv = h // groups
+            for hk in range(h_kv):
+                dk_ref[0, :, hk * d:(hk + 1) * d] = dk_acc_ref[hk].astype(
+                    dk_ref.dtype
+                )
+                dv_ref[0, :, hk * d:(hk + 1) * d] = dv_acc_ref[hk].astype(
+                    dv_ref.dtype
+                )
+        else:
+            for pi in range(h // pack):
+                dk_ref[0, :, pi * 128:(pi + 1) * 128] = dk_acc_ref[pi].astype(
+                    dk_ref.dtype
+                )
+                dv_ref[0, :, pi * 128:(pi + 1) * 128] = dv_acc_ref[pi].astype(
+                    dv_ref.dtype
+                )
 
 
 def _q_clamp_flat(active: bool, q_len: int, kv_len: int,
@@ -861,11 +1077,12 @@ def _flash_flat_fwd_impl(
     vp = _pad_to(vf, 1, block_k)
     nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
 
+    pack = _flat_pack(h, d, groups)
     kernel = functools.partial(
         _fwd_flat_kernel,
         sm_scale=sm_scale, causal=causal, use_ids=use_ids,
         q_len=q_len, kv_len=kv_len,
-        block_q=block_q, block_k=block_k, h=h, d=d, groups=groups,
+        block_q=block_q, block_k=block_k, h=h, d=d, groups=groups, pack=pack,
     )
     # Same dead-block DMA clamp as the [B,H,S,D] forward (see its note);
     # id-based runs keep the plain map (data-dependent live set).
@@ -905,7 +1122,10 @@ def _flash_flat_fwd_impl(
             ),
         ],
         scratch_shapes=[
-            pltpu.VMEM((h, block_q, d), jnp.float32),
+            # Packed: one [block_q, 128] accumulator per head PAIR
+            # (lanes = the pair's heads side by side) — same bytes as
+            # the per-head (h, block_q, d) layout it replaces.
+            pltpu.VMEM((h // pack, block_q, d * pack), jnp.float32),
             # m/l: per-head stats packed into lanes (head hh = lane hh)
             # of ONE tile each; per-head 128-lane tiles would cost h x
             # more VMEM for the same information.
@@ -950,10 +1170,11 @@ def _flash_flat_bwd_impl(
     deltap = _pad_to(delta, 1, block_q)
     nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
 
+    pack = _flat_pack(h, d, groups)
     common = dict(
         sm_scale=sm_scale, causal=causal, use_ids=use_ids,
         q_len=q_len, kv_len=kv_len,
-        block_q=block_q, block_k=block_k, h=h, d=d, groups=groups,
+        block_q=block_q, block_k=block_k, h=h, d=d, groups=groups, pack=pack,
     )
     operands = [qp, kp, vp, dop, lsep, deltap]
     id_operands = []
@@ -986,7 +1207,8 @@ def _flash_flat_bwd_impl(
         out_shape=jax.ShapeDtypeStruct(
             qp.shape, qf.dtype, vma=jax.typeof(qp).vma
         ),
-        scratch_shapes=[pltpu.VMEM((h, block_q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((h // pack, block_q, d * pack),
+                                   jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
@@ -1021,8 +1243,8 @@ def _flash_flat_bwd_impl(
             jax.ShapeDtypeStruct(vp.shape, vf.dtype, vma=jax.typeof(vp).vma),
         ],
         scratch_shapes=[
-            pltpu.VMEM((h_kv, block_k, d), jnp.float32),
-            pltpu.VMEM((h_kv, block_k, d), jnp.float32),
+            pltpu.VMEM((h_kv // pack, block_k, d * pack), jnp.float32),
+            pltpu.VMEM((h_kv // pack, block_k, d * pack), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")

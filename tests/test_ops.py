@@ -609,3 +609,73 @@ class TestRingAttentionBshd:
             np.testing.assert_allclose(
                 got, want, atol=5e-4, rtol=1e-3, err_msg=f"d{name} mismatch"
             )
+
+
+class TestFlatHeadPacking:
+    """The packed-head inner loop of the flat kernels (pack = 128//d
+    heads per aligned 128-lane block, block-diagonal k/v tiles —
+    ops/attention.py:_flat_pack). The hardware A/B that motivated it is
+    hack/headdim_probe.py (1.6-1.8x at bert geometry); these tests pin
+    the dispatch contract and the numerics of every pack width."""
+
+    def test_dispatch_table(self):
+        from mpi_operator_tpu.ops.attention import _flat_pack
+
+        assert _flat_pack(12, 64, 1) == 2    # bert/vit/seq2seq class
+        assert _flat_pack(8, 32, 1) == 4
+        assert _flat_pack(16, 128, 1) == 1   # llama class: plain loop
+        assert _flat_pack(3, 64, 1) == 1     # h not divisible by pack
+        assert _flat_pack(12, 64, 2) == 1    # GQA: plain loop
+        assert _flat_pack(4, 96, 1) == 1     # 128 % d != 0
+        assert _flat_pack(2, 256, 1) == 1    # d > 128
+
+    @staticmethod
+    def _bshd(x):
+        return x.transpose(0, 2, 1, 3)
+
+    @pytest.mark.parametrize("h,d", [(2, 64), (4, 32)])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_packed_matches_reference_with_grads(self, h, d, causal):
+        """pack=2 and pack=4 forward + all three gradients vs the dense
+        oracle, through the public bshd entry point (which flattens to
+        the packed flat kernels)."""
+        q, k, v = _qkv(b=2, h=h, sq=200, d=d)
+
+        def loss_flat(q, k, v):
+            return jnp.sum(
+                flash_attention_bshd(
+                    self._bshd(q), self._bshd(k), self._bshd(v),
+                    causal=causal, block_q=128, block_k=128,
+                ) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+        out = flash_attention_bshd(
+            self._bshd(q), self._bshd(k), self._bshd(v), causal=causal,
+            block_q=128, block_k=128,
+        )
+        np.testing.assert_allclose(
+            self._bshd(out), attention_reference(q, k, v, causal=causal),
+            atol=2e-5, rtol=2e-5,
+        )
+        g_flat = jax.grad(loss_flat, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for got, want, name in zip(g_flat, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                got, want, atol=5e-4, rtol=1e-3, err_msg=f"d{name} mismatch"
+            )
+
+    def test_fallback_h_odd_matches_packed_shapes(self):
+        """h=3/d=64 dispatches to the plain loop; parity with the dense
+        oracle pins that the fallback stayed intact next to the packed
+        branch."""
+        q, k, v = _qkv(b=1, h=3, sq=160, d=64)
+        out = flash_attention_bshd(
+            self._bshd(q), self._bshd(k), self._bshd(v), causal=True,
+        )
+        np.testing.assert_allclose(
+            self._bshd(out), attention_reference(q, k, v, causal=True),
+            atol=2e-5, rtol=2e-5,
+        )
