@@ -30,13 +30,14 @@ import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 
 from ..api.v2beta1 import constants
 from ..controller.tpu_job_controller import TPUJobController
 from ..runtime.apiserver import InMemoryAPIServer, NotFoundError
 from ..runtime.leaderelection import LeaderElectionConfig, LeaderElector
 from ..runtime.podrunner import LocalPodRunner
-from ..utils import metrics
+from ..utils import metrics, trace
 from ..version import version_string
 
 
@@ -86,6 +87,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 class _MonitoringHandler(BaseHTTPRequestHandler):
     registry: metrics.Registry = None
+    tracer: trace.Tracer = None
     health_fn = staticmethod(lambda: True)
 
     def do_GET(self):  # noqa: N802
@@ -98,6 +100,14 @@ class _MonitoringHandler(BaseHTTPRequestHandler):
             body = b"ok" if ok else b"unhealthy"
             self.send_response(200 if ok else 500)
             self.send_header("Content-Type", "text/plain")
+        elif self.path == "/debug/trace":
+            # The span ring buffer as JSONL, oldest span first: one
+            # reconcile cycle reads as a reconcile line followed by its
+            # builders.* children (same trace_id).
+            jsonl = self.tracer.to_jsonl()
+            body = (jsonl + "\n").encode() if jsonl else b""
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
         else:
             body = b"not found"
             self.send_response(404)
@@ -111,12 +121,19 @@ class _MonitoringHandler(BaseHTTPRequestHandler):
 
 
 def start_monitoring(port: int, registry: metrics.Registry, health_fn,
-                     address: str = "127.0.0.1"):
-    """startMonitoring (main.go:29-40) + healthz server (:192-208) analog."""
+                     address: str = "127.0.0.1",
+                     tracer: Optional[trace.Tracer] = None):
+    """startMonitoring (main.go:29-40) + healthz server (:192-208) analog,
+    plus the ``/debug/trace`` span dump."""
     handler = type(
         "Handler",
         (_MonitoringHandler,),
-        {"registry": registry, "health_fn": staticmethod(health_fn)},
+        {
+            "registry": registry,
+            # "is None", not "or": an empty Tracer is falsy (__len__).
+            "tracer": trace.DEFAULT_TRACER if tracer is None else tracer,
+            "health_fn": staticmethod(health_fn),
+        },
     )
     server = ThreadingHTTPServer((address, port), handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
@@ -184,12 +201,13 @@ def run(argv=None) -> int:
         # the client at scrape time.
         rest_retries = metrics.new_counter(
             "tpu_operator_rest_client_retries_total",
-            "requests retried after 429/transient failures", registry,
+            "requests retried after 429/transient failures",
+            registry=registry,
         )
         rest_throttle = metrics.new_counter(
             "tpu_operator_rest_client_throttle_seconds_total",
             "seconds spent waiting on the client-side QPS limiter",
-            registry,
+            registry=registry,
         )
         registry.on_scrape(lambda: (
             rest_retries.mirror_total(api.retry_count),

@@ -125,6 +125,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "cosine decay to 0 at --steps")
     p.add_argument("--warmup-steps", type=int, default=0,
                    help="linear LR warmup steps (cosine schedule)")
+    p.add_argument("--telemetry-every", type=int, default=50,
+                   help="emit a train_telemetry JSONL record (step time, "
+                        "tokens/sec, goodput) every N steps; 0 disables "
+                        "the periodic records (the final-JSON goodput "
+                        "stays)")
+    p.add_argument("--telemetry-path", default="",
+                   help="append the telemetry JSONL here instead of stderr")
     return p
 
 
@@ -148,17 +155,20 @@ class Workload:
 
     ``batch_fn(step)``, when set, supplies a fresh batch per step (real
     data via the prefetcher); otherwise the fixed synthetic ``batch`` is
-    reused every step."""
+    reused every step.  ``tokens_per_step`` is 0 for token-free models
+    (vision), in which case telemetry reports examples/sec only."""
 
     def __init__(self, *, state: dict, step_fn: Callable, batch: tuple,
                  examples_per_step: int, mesh,
-                 batch_fn: Optional[Callable[[int], tuple]] = None):
+                 batch_fn: Optional[Callable[[int], tuple]] = None,
+                 tokens_per_step: int = 0):
         self.state = state
         self.step_fn = step_fn
         self.batch = batch
         self.examples_per_step = examples_per_step
         self.mesh = mesh
         self.batch_fn = batch_fn
+        self.tokens_per_step = tokens_per_step
 
 
 def _resnet_workload(args, mesh, n_devices: int) -> Workload:
@@ -322,6 +332,7 @@ def _seq2seq_workload(args, mesh, n_devices: int) -> Workload:
         batch=(src_s, tgt_s),
         examples_per_step=global_batch,
         mesh=mesh,
+        tokens_per_step=global_batch * (src_len + dec_len),
     )
 
 
@@ -516,6 +527,7 @@ def _llama_pp_workload(args, mesh, sizes, global_batch, rng, optimizer):
         examples_per_step=global_batch,
         mesh=mesh,
         batch_fn=batch_fn,
+        tokens_per_step=global_batch * args.seq_len,
     )
 
 
@@ -765,6 +777,7 @@ def _lm_workload(args, mesh, n_devices: int) -> Workload:
         examples_per_step=global_batch,
         mesh=mesh,
         batch_fn=batch_fn,
+        tokens_per_step=global_batch * args.seq_len,
     )
 
 
@@ -844,7 +857,7 @@ def main(argv=None) -> int:
         print(json.dumps({
             "model": args.model, "steps": 0, "final_step": start_step,
             "loss": None, "examples_per_sec": 0.0, "step_ms": 0.0,
-            "devices": len(devices), "preempted": False,
+            "goodput": 0.0, "devices": len(devices), "preempted": False,
         }))
         return 0
     warmup = max(args.warmup, 1)
@@ -885,6 +898,24 @@ def main(argv=None) -> int:
                 multihost_utils.process_allgather(_np.array([local])).max()
             )
 
+    from ..utils import metrics as metrics_lib
+    from ..utils import telemetry as telemetry_lib
+
+    # Fresh registry per run: a long-lived process (tests, notebooks)
+    # re-entering main() must not stack duplicate series in the default
+    # registry.  Step durations are dispatch-to-dispatch wall deltas —
+    # JAX dispatch is async, so forcing a device sync per step to time it
+    # would cost the throughput we are measuring; the deltas still sum to
+    # true wall time, and warmup (compile) steps land in the goodput
+    # denominator but not the numerator.
+    telem = telemetry_lib.TrainingTelemetry(
+        tokens_per_step=work.tokens_per_step,
+        examples_per_step=work.examples_per_step,
+        registry=metrics_lib.Registry(),
+        interval=max(args.telemetry_every, 0),
+        jsonl_path=args.telemetry_path,
+    )
+
     batches = None
     if work.batch_fn is not None:
         from ..data import Prefetcher
@@ -898,6 +929,8 @@ def main(argv=None) -> int:
     with work.mesh:
         t0 = t_log = None
         step = last_log_step = start_step
+        telem.start()
+        t_prev = time.perf_counter()
         while step < end:
             if step == timed_from:
                 jax.block_until_ready(work.state)
@@ -909,6 +942,9 @@ def main(argv=None) -> int:
             batch = next(batches)[1] if batches is not None else work.batch
             work.state, loss = work.step_fn(work.state, batch)
             step += 1
+            now = time.perf_counter()
+            telem.record_step(step, now - t_prev, warmup=step <= timed_from)
+            t_prev = now
             if tracing and step == timed_from + 13:
                 jax.block_until_ready(loss)
                 jax.profiler.stop_trace()
@@ -952,26 +988,31 @@ def main(argv=None) -> int:
     # commit must not kill the process mid-write.
     signal.signal(signal.SIGTERM, prev_handler)
 
+    # Goodput AFTER the final checkpoint commit: durable-save time is
+    # exactly the kind of non-productive wall time it should expose.
+    telem.close(step)
     examples_per_sec = (
         work.examples_per_step * timed_steps / elapsed if elapsed > 0 else 0.0
     )
-    print(
-        json.dumps(
-            {
-                "model": args.model,
-                "steps": step - start_step,
-                "final_step": step,
-                "loss": final_loss,
-                "examples_per_sec": round(examples_per_sec, 2),
-                "step_ms": (
-                    round(elapsed / timed_steps * 1000, 2)
-                    if timed_steps else 0.0
-                ),
-                "devices": len(devices),
-                "preempted": preempted.is_set(),
-            }
+    summary = {
+        "model": args.model,
+        "steps": step - start_step,
+        "final_step": step,
+        "loss": final_loss,
+        "examples_per_sec": round(examples_per_sec, 2),
+        "step_ms": (
+            round(elapsed / timed_steps * 1000, 2)
+            if timed_steps else 0.0
+        ),
+        "goodput": round(telem.goodput_ratio(), 4),
+        "devices": len(devices),
+        "preempted": preempted.is_set(),
+    }
+    if work.tokens_per_step and elapsed > 0:
+        summary["tokens_per_sec"] = round(
+            work.tokens_per_step * timed_steps / elapsed, 1
         )
-    )
+    print(json.dumps(summary))
     return 0
 
 

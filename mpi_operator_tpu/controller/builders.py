@@ -21,6 +21,7 @@ SSH/MPI machinery replaced by TPU-native wiring:
 from __future__ import annotations
 
 import copy
+import functools
 
 from ..api import topology
 from ..api.v2beta1 import constants
@@ -32,6 +33,23 @@ from ..api.v2beta1.types import (
     TPUJob,
 )
 from ..runtime.objects import KubeObject, ObjectMeta, OwnerReference
+from ..utils import trace
+
+
+def _traced(span_name: str):
+    """Open a span on the default tracer around an object builder. Builders
+    run inside the controller's ``reconcile`` span on the same thread, so
+    these become its children in ``/debug/trace``."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(job: TPUJob, *args, **kwargs):
+            with trace.span(span_name, job=f"{job.namespace}/{job.name}"):
+                return fn(job, *args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 
 def controller_ref(job: TPUJob) -> dict:
@@ -93,6 +111,7 @@ def slice_shape(job: TPUJob) -> topology.SliceShape:
     return topology.resolve(job.spec.tpu.accelerator_type, job.spec.tpu.topology)
 
 
+@_traced("builders.new_service")
 def new_service(job: TPUJob, name: str, selector: dict[str, str]) -> KubeObject:
     """Headless Service (newService :1157-1174 analog)."""
     return KubeObject(
@@ -112,6 +131,7 @@ def new_workers_service(job: TPUJob) -> KubeObject:
     return new_service(job, workers_service_name(job), worker_selector(job.name))
 
 
+@_traced("builders.new_config_map")
 def new_config_map(job: TPUJob, replicas: int) -> KubeObject:
     """Worker-hostnames ConfigMap (newConfigMap :1106-1128 analog).
 
@@ -199,6 +219,7 @@ def _worker_env(job: TPUJob, index: int, shape: topology.SliceShape) -> list[dic
     return env
 
 
+@_traced("builders.new_worker")
 def new_worker(job: TPUJob, index: int, gang_scheduler_name: str = "") -> KubeObject:
     """Worker Pod (newWorker :1249-1304 analog)."""
     shape = slice_shape(job)
@@ -253,6 +274,7 @@ def new_worker(job: TPUJob, index: int, gang_scheduler_name: str = "") -> KubeOb
     return KubeObject("v1", "Pod", meta, spec=pod_spec)
 
 
+@_traced("builders.new_launcher_job")
 def new_launcher_job(job: TPUJob, gang_scheduler_name: str = "") -> KubeObject:
     """Launcher batch Job (newLauncherJob :1306-1325 analog), optional in a
     TPUJob: orchestration-only duties (eval loops, logging), never rank
@@ -314,6 +336,7 @@ def new_launcher_job(job: TPUJob, gang_scheduler_name: str = "") -> KubeObject:
     )
 
 
+@_traced("builders.new_pod_group")
 def new_pod_group(job: TPUJob, min_member: int) -> KubeObject:
     """PodGroup (newPodGroup :1218-1240 analog)."""
     priority_class = ""

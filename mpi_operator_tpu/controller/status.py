@@ -94,24 +94,30 @@ def is_suspended(status: JobStatus) -> bool:
 def update_job_conditions(
     job: TPUJob, type_: str, reason: str, message: str,
     status: str = CONDITION_TRUE, now: Optional[float] = None,
-) -> None:
-    set_condition(job.status, new_condition(type_, reason, message, status, now))
+) -> bool:
+    """Set one condition; True iff the stored conditions changed (the
+    signal observability layers key transition timestamps off)."""
+    return set_condition(
+        job.status, new_condition(type_, reason, message, status, now)
+    )
 
 
-def set_condition(status: JobStatus, condition: JobCondition) -> None:
-    """:100-117 analog: idempotent set with transition-time preservation."""
+def set_condition(status: JobStatus, condition: JobCondition) -> bool:
+    """:100-117 analog: idempotent set with transition-time preservation.
+    Returns True when the condition list actually changed."""
     current = get_condition(status, condition.type)
     if (
         current is not None
         and current.status == condition.status
         and current.reason == condition.reason
     ):
-        return  # nothing changed
+        return False  # nothing changed
     if current is not None and current.status == condition.status:
         condition.last_transition_time = current.last_transition_time
     status.conditions = _filter_out_condition(status.conditions, condition.type) + [
         condition
     ]
+    return True
 
 
 def _filter_out_condition(

@@ -53,7 +53,7 @@ from ..runtime.client import KubeClient, SchedulingClient, TPUJobClient
 from ..runtime.informer import EventHandler, InformerFactory, meta_namespace_key, split_key
 from ..runtime.objects import KubeObject
 from ..runtime.workqueue import RateLimitingQueue
-from ..utils import metrics
+from ..utils import metrics, trace
 from ..utils.events import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING, EventRecorder, truncate_message
 from . import builders, status as st
 
@@ -111,6 +111,7 @@ class TPUJobController:
         gang_scheduler_name: str = "",
         recorder: Optional[EventRecorder] = None,
         registry: Optional[metrics.Registry] = None,
+        tracer: Optional[trace.Tracer] = None,
         clock: Callable[[], float] = time.time,
     ):
         self.api = api
@@ -123,19 +124,44 @@ class TPUJobController:
 
         registry = registry or metrics.Registry()
         self.registry = registry
+        # "is None", not "or": an empty Tracer is falsy (it has __len__).
+        self.tracer = trace.DEFAULT_TRACER if tracer is None else tracer
         self.jobs_created = metrics.new_counter(
-            "tpu_operator_jobs_created_total", "Counts number of TPU jobs created", registry
+            "tpu_operator_jobs_created_total", "Counts number of TPU jobs created",
+            registry=registry,
         )
         self.jobs_successful = metrics.new_counter(
-            "tpu_operator_jobs_successful_total", "Counts number of TPU jobs successful", registry
+            "tpu_operator_jobs_successful_total", "Counts number of TPU jobs successful",
+            registry=registry,
         )
         self.jobs_failed = metrics.new_counter(
-            "tpu_operator_jobs_failed_total", "Counts number of TPU jobs failed", registry
+            "tpu_operator_jobs_failed_total", "Counts number of TPU jobs failed",
+            registry=registry,
         )
         self.job_info = metrics.new_gauge(
             "tpu_operator_job_info",
             "Information about TPUJob",
             ("launcher", "namespace"),
+            registry,
+        )
+        # Reconcile observability: where sync time goes, what fails, and
+        # when each job condition last flipped.
+        self.sync_duration = metrics.new_histogram(
+            "tpu_operator_reconcile_duration_seconds",
+            "Wall time of one sync_handler pass, by outcome",
+            ("result",),
+            registry,
+        )
+        self.sync_errors = metrics.new_counter(
+            "tpu_operator_reconcile_errors_total",
+            "Sync passes that raised, by exception class",
+            ("reason",),
+            registry,
+        )
+        self.condition_transitions = metrics.new_gauge(
+            "tpu_operator_job_condition_transition_timestamp_seconds",
+            "Unix time a TPUJob condition last transitioned",
+            ("namespace", "tpujob", "type"),
             registry,
         )
 
@@ -149,7 +175,7 @@ class TPUJobController:
         self.job_informer = self.factory.informer("jobs")
         self.podgroup_informer = self.factory.informer("podgroups")
 
-        self.queue = RateLimitingQueue(name="TPUJobs")
+        self.queue = RateLimitingQueue(name="TPUJobs", registry=registry)
 
         # Injectable for tests (updateStatusHandler :244-245 analog).
         self.update_status_handler: Callable[[TPUJob], None] = self._do_update_job_status
@@ -305,13 +331,53 @@ class TPUJobController:
     # The sync handler
     # ------------------------------------------------------------------
 
+    def _set_condition(
+        self,
+        job: TPUJob,
+        type_: str,
+        reason: str,
+        message: str,
+        *,
+        status: str = st.CONDITION_TRUE,
+        now: float,
+    ) -> None:
+        """update_job_conditions + the condition-transition timestamp
+        metric: the gauge only moves when the stored conditions actually
+        changed, so re-syncs never smear transition times."""
+        if st.update_job_conditions(
+            job, type_, reason, message, status=status, now=now
+        ):
+            # Mirror the stored last_transition_time, not ``now``: a
+            # reason-only update preserves the original transition time.
+            cond = st.get_condition(job.status, type_)
+            self.condition_transitions.set(
+                cond.last_transition_time if cond is not None else now,
+                job.namespace, job.name, type_,
+            )
+
     def sync_handler(self, key: str) -> None:
+        """Instrumented entrypoint: every sync pass — worker loop or
+        direct test drive — lands in the latency histogram, the error
+        counter, and the trace ring buffer."""
+        t0 = time.perf_counter()
+        with self.tracer.span("reconcile", key=key):
+            try:
+                self._sync_job(key)
+            except Exception as e:
+                self.sync_duration.observe(time.perf_counter() - t0, "error")
+                self.sync_errors.inc(1, type(e).__name__)
+                raise
+        self.sync_duration.observe(time.perf_counter() - t0, "success")
+
+    def _sync_job(self, key: str) -> None:
         """:451-589 analog."""
         namespace, name = split_key(key)
         shared = self.tpujob_informer.lister.get(namespace, name)
         if shared is None:
-            # Deleted; dependents go via GC. Drop its info series.
+            # Deleted; dependents go via GC. Drop its info series and any
+            # condition-transition timestamps.
             self.job_info.remove(name + constants.LAUNCHER_SUFFIX, namespace)
+            self.condition_transitions.remove_matching(namespace, name)
             return
         job = TPUJob.from_dict(shared)  # never mutate the cache (:475-478)
         # Baseline for change detection: the status as stored *before* this
@@ -334,7 +400,7 @@ class TPUJobController:
 
         if not job.status.conditions:
             msg = f"TPUJob {job.namespace}/{job.name} is created."
-            st.update_job_conditions(
+            self._set_condition(
                 job, JOB_CREATED, st.TPUJOB_CREATED_REASON, msg, now=self.clock()
             )
             self.recorder.event(job, EVENT_TYPE_NORMAL, st.TPUJOB_CREATED_REASON, msg)
@@ -347,7 +413,7 @@ class TPUJobController:
 
         if st.is_suspended(job.status):
             msg = f"TPUJob {job.namespace}/{job.name} is resumed."
-            st.update_job_conditions(
+            self._set_condition(
                 job,
                 JOB_SUSPENDED,
                 st.TPUJOB_RESUMED_REASON,
@@ -733,7 +799,7 @@ class TPUJobController:
                 f"restarting workers for rejoin (world size {replicas}): "
                 + ", ".join(restarted)
             )
-            st.update_job_conditions(
+            self._set_condition(
                 job,
                 JOB_RESTARTING,
                 st.TPUJOB_RESTARTING_REASON,
@@ -816,7 +882,7 @@ class TPUJobController:
                 pass
         if not st.is_suspended(job.status):
             msg = f"TPUJob {job.namespace}/{job.name} is suspended."
-            st.update_job_conditions(
+            self._set_condition(
                 job, JOB_SUSPENDED, st.TPUJOB_SUSPENDED_REASON, msg, now=self.clock()
             )
             self.recorder.event(job, EVENT_TYPE_NORMAL, st.TPUJOB_SUSPENDED_REASON, msg)
@@ -901,7 +967,7 @@ class TPUJobController:
                         job.status.completion_time = (
                             (launcher.get("status") or {}).get("completionTime") or now
                         )
-                    st.update_job_conditions(
+                    self._set_condition(
                         job, JOB_SUCCEEDED, st.TPUJOB_SUCCEEDED_REASON, msg, now=now
                     )
                     self.jobs_successful.inc()
@@ -935,7 +1001,7 @@ class TPUJobController:
         # terminal condition.
         if evicted > 0 and not st.is_finished(job.status):
             msg = f"{evicted}/{len(workers)} workers are evicted"
-            st.update_job_conditions(
+            self._set_condition(
                 job, JOB_FAILED, st.TPUJOB_EVICTED_REASON, msg, now=now
             )
             self.recorder.event(job, EVENT_TYPE_WARNING, st.TPUJOB_EVICTED_REASON, msg)
@@ -952,7 +1018,7 @@ class TPUJobController:
             # reference emits here (:960-963).
             already = st.has_condition(job.status, JOB_RUNNING)
             msg = f"TPUJob {job.namespace}/{job.name} is running."
-            st.update_job_conditions(
+            self._set_condition(
                 job, JOB_RUNNING, st.TPUJOB_RUNNING_REASON, msg, now=now
             )
             if not already:
@@ -987,7 +1053,7 @@ class TPUJobController:
                 self.recorder.event(job, EVENT_TYPE_NORMAL, st.TPUJOB_SUCCEEDED_REASON, msg)
                 if job.status.completion_time is None:
                     job.status.completion_time = now
-                st.update_job_conditions(
+                self._set_condition(
                     job, JOB_SUCCEEDED, st.TPUJOB_SUCCEEDED_REASON, msg, now=now
                 )
                 self.jobs_successful.inc()
@@ -1010,7 +1076,7 @@ class TPUJobController:
                 self.recorder.event(job, EVENT_TYPE_WARNING, reason, msg)
                 if job.status.completion_time is None:
                     job.status.completion_time = now
-                st.update_job_conditions(job, JOB_FAILED, reason, msg, now=now)
+                self._set_condition(job, JOB_FAILED, reason, msg, now=now)
                 self.jobs_failed.inc()
 
             # activeDeadlineSeconds has no launcher Job to enforce it here;
@@ -1030,7 +1096,7 @@ class TPUJobController:
                     job, EVENT_TYPE_WARNING, DEADLINE_EXCEEDED_REASON, msg
                 )
                 job.status.completion_time = now
-                st.update_job_conditions(
+                self._set_condition(
                     job, JOB_FAILED, DEADLINE_EXCEEDED_REASON, msg, now=now
                 )
                 self.jobs_failed.inc()
@@ -1059,7 +1125,7 @@ class TPUJobController:
         self.recorder.event(job, EVENT_TYPE_WARNING, reason, msg)
         if job.status.completion_time is None:
             job.status.completion_time = now
-        st.update_job_conditions(job, JOB_FAILED, reason, msg, now=now)
+        self._set_condition(job, JOB_FAILED, reason, msg, now=now)
         self.jobs_failed.inc()
 
     def _do_update_job_status(self, job: TPUJob) -> None:

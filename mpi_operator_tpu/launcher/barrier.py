@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Optional
 
+from ..utils import trace
+
 log = logging.getLogger(__name__)
 
 MAGIC = b"TPUB"
@@ -233,31 +235,37 @@ def gang_barrier(
     Raises TimeoutError if the gang does not assemble in time.
     """
     engine = "native" if _native is not None else "python"
-    server: Optional[threading.Thread] = None
-    serve_rc: list[int] = [0]
-    if rank == 0:
-        def _run():
-            serve_rc[0] = serve(port, world_size, timeout_s)
+    with trace.span(
+        "launcher.gang_barrier", rank=rank, world_size=world_size, engine=engine
+    ):
+        server: Optional[threading.Thread] = None
+        serve_rc: list[int] = [0]
+        if rank == 0:
+            def _run():
+                serve_rc[0] = serve(port, world_size, timeout_s)
 
-        server = threading.Thread(target=_run, daemon=True, name="tpujob-barrier")
-        server.start()
-        host = "127.0.0.1"  # rank 0 dials its own server locally
-    else:
-        host = coordinator_host
-
-    log.info(
-        "gang barrier (%s): rank %d/%d via %s:%d", engine, rank, world_size,
-        host, port,
-    )
-    rc = wait(host, port, rank, timeout_s)
-    if server is not None:
-        server.join(timeout=timeout_s)
-        if serve_rc[0] != 0:
-            raise TimeoutError(
-                f"barrier server failed (rc={serve_rc[0]}): "
-                f"{world_size - 1} peer(s) missing"
+            server = threading.Thread(
+                target=_run, daemon=True, name="tpujob-barrier"
             )
-    if rc != 0:
-        raise TimeoutError(
-            f"rank {rank} gang barrier timed out after {timeout_s:.0f}s (rc={rc})"
+            server.start()
+            host = "127.0.0.1"  # rank 0 dials its own server locally
+        else:
+            host = coordinator_host
+
+        log.info(
+            "gang barrier (%s): rank %d/%d via %s:%d", engine, rank,
+            world_size, host, port,
         )
+        rc = wait(host, port, rank, timeout_s)
+        if server is not None:
+            server.join(timeout=timeout_s)
+            if serve_rc[0] != 0:
+                raise TimeoutError(
+                    f"barrier server failed (rc={serve_rc[0]}): "
+                    f"{world_size - 1} peer(s) missing"
+                )
+        if rc != 0:
+            raise TimeoutError(
+                f"rank {rank} gang barrier timed out after {timeout_s:.0f}s "
+                f"(rc={rc})"
+            )

@@ -16,6 +16,7 @@ from dataclasses import dataclass
 from typing import Mapping, Optional
 
 from ..api.v2beta1 import constants
+from ..utils import trace
 
 log = logging.getLogger(__name__)
 
@@ -173,43 +174,50 @@ def initialize(
         return cfg
     if _initialized:
         return cfg
-    # Multislice: libtpu reads MEGASCALE_* from the environment on its
-    # own; our job is to fail fast if the controller-rendered wiring is
-    # inconsistent rather than hang in the first cross-slice collective.
-    cfg.check_multislice()
-    if cfg.is_multislice:
-        log.info(
-            "multislice world: slice %d/%d, DCN coordinator %s",
-            cfg.slice_id, cfg.num_slices, cfg.megascale_coordinator_address,
-        )
-
-    if readiness_barrier and cfg.coordinator_address:
-        from . import barrier
-
-        host, _, port_str = cfg.coordinator_address.partition(":")
-        barrier.gang_barrier(
-            coordinator_host=host,
-            port=int(port_str or constants.DEFAULT_COORDINATOR_PORT) + 1,
-            rank=cfg.process_id,
-            world_size=cfg.num_processes,
-            timeout_s=initialization_timeout_seconds,
-        )
-
-    import jax
-
-    log.info(
-        "jax.distributed.initialize coordinator=%s process=%d/%d",
-        cfg.coordinator_address,
-        cfg.process_id,
-        cfg.num_processes,
-    )
-    jax.distributed.initialize(
-        coordinator_address=cfg.coordinator_address,
-        num_processes=cfg.num_processes,
+    with trace.span(
+        "launcher.initialize",
         process_id=cfg.process_id,
-        initialization_timeout=initialization_timeout_seconds,
-    )
-    _initialized = True
+        num_processes=cfg.num_processes,
+        num_slices=cfg.num_slices,
+    ):
+        # Multislice: libtpu reads MEGASCALE_* from the environment on its
+        # own; our job is to fail fast if the controller-rendered wiring is
+        # inconsistent rather than hang in the first cross-slice collective.
+        cfg.check_multislice()
+        if cfg.is_multislice:
+            log.info(
+                "multislice world: slice %d/%d, DCN coordinator %s",
+                cfg.slice_id, cfg.num_slices, cfg.megascale_coordinator_address,
+            )
+
+        if readiness_barrier and cfg.coordinator_address:
+            from . import barrier
+
+            host, _, port_str = cfg.coordinator_address.partition(":")
+            barrier.gang_barrier(
+                coordinator_host=host,
+                port=int(port_str or constants.DEFAULT_COORDINATOR_PORT) + 1,
+                rank=cfg.process_id,
+                world_size=cfg.num_processes,
+                timeout_s=initialization_timeout_seconds,
+            )
+
+        import jax
+
+        log.info(
+            "jax.distributed.initialize coordinator=%s process=%d/%d",
+            cfg.coordinator_address,
+            cfg.process_id,
+            cfg.num_processes,
+        )
+        with trace.span("launcher.jax_distributed_initialize"):
+            jax.distributed.initialize(
+                coordinator_address=cfg.coordinator_address,
+                num_processes=cfg.num_processes,
+                process_id=cfg.process_id,
+                initialization_timeout=initialization_timeout_seconds,
+            )
+        _initialized = True
     return cfg
 
 

@@ -13,6 +13,11 @@ Semantics kept from client-go:
 - ``add_rate_limited`` delays re-adds exponentially per item until
   ``forget()`` resets the failure count;
 - ``shutdown()`` unblocks all getters.
+
+Passing a ``registry`` arms the client-go workqueue metric set
+(``workqueue_depth``, ``adds_total``, ``queue_duration_seconds``,
+``work_duration_seconds``, ``retries_total``, ``unfinished_work_seconds``
+analogs), every series labeled by queue ``name``.
 """
 
 from __future__ import annotations
@@ -21,6 +26,65 @@ import heapq
 import threading
 import time
 from typing import Any, Hashable, Optional
+
+from ..utils import metrics
+
+# Queue/work latencies span informer-event microseconds up to multi-second
+# syncs against a real apiserver: wider-than-default buckets at both ends
+# (client-go uses 1e-8..~10s exponential buckets for the same reason).
+_LATENCY_BUCKETS = (
+    1e-6, 1e-5, 1e-4, 1e-3, 0.01, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class WorkqueueMetrics:
+    """The six client-go workqueue metrics, bound to one registry.
+
+    One instance can serve many queues (series split by the ``name``
+    label), matching client-go's MetricsProvider shape. All clock reads
+    come from the owning queue so tests can drive time.
+    """
+
+    def __init__(self, registry: metrics.Registry):
+        self.depth = metrics.new_gauge(
+            "tpu_operator_workqueue_depth",
+            "Current depth of the workqueue",
+            ("name",),
+            registry,
+        )
+        self.adds = metrics.new_counter(
+            "tpu_operator_workqueue_adds_total",
+            "Total number of adds handled by the workqueue",
+            ("name",),
+            registry,
+        )
+        self.queue_duration = metrics.new_histogram(
+            "tpu_operator_workqueue_queue_duration_seconds",
+            "How long an item stays in the workqueue before being requested",
+            ("name",),
+            registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self.work_duration = metrics.new_histogram(
+            "tpu_operator_workqueue_work_duration_seconds",
+            "How long processing an item from the workqueue takes",
+            ("name",),
+            registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self.retries = metrics.new_counter(
+            "tpu_operator_workqueue_retries_total",
+            "Total number of rate-limited re-adds (retries)",
+            ("name",),
+            registry,
+        )
+        self.unfinished_work = metrics.new_gauge(
+            "tpu_operator_workqueue_unfinished_work_seconds",
+            "Seconds of work in progress that has not been observed by "
+            "work_duration yet (large values indicate stuck threads)",
+            ("name",),
+            registry,
+        )
 
 
 class ItemExponentialFailureRateLimiter:
@@ -54,6 +118,8 @@ class RateLimitingQueue:
         rate_limiter: Optional[ItemExponentialFailureRateLimiter] = None,
         name: str = "",
         clock=time.monotonic,
+        registry: Optional[metrics.Registry] = None,
+        queue_metrics: Optional[WorkqueueMetrics] = None,
     ):
         self.name = name
         self._rate_limiter = rate_limiter or ItemExponentialFailureRateLimiter()
@@ -66,6 +132,57 @@ class RateLimitingQueue:
         self._delayed: list[tuple[float, int, Any]] = []  # heap (ready_at, seq, item)
         self._seq = 0
         self._shutdown = False
+        # Instrumentation (client-go workqueue metrics analog). A shared
+        # WorkqueueMetrics wins over a bare registry; both absent = no-op.
+        self._metrics = queue_metrics
+        if self._metrics is None and registry is not None:
+            self._metrics = WorkqueueMetrics(registry)
+        self._add_times: dict[Hashable, float] = {}  # queued-at, per item
+        self._start_times: dict[Hashable, float] = {}  # processing-start
+        if self._metrics is not None and registry is not None:
+            # unfinished_work is a pull-model value: freshest at scrape.
+            registry.on_scrape(self._update_unfinished_work)
+
+    @property
+    def metrics(self) -> Optional[WorkqueueMetrics]:
+        """The bound WorkqueueMetrics, or None when unmetered."""
+        return self._metrics
+
+    # -- instrumentation hooks (no-ops when unmetered) -------------------
+
+    def _on_enqueued(self, item: Hashable) -> None:
+        """Item landed in the ready FIFO (fresh add, delayed promotion, or
+        dirty re-queue). Caller holds self._cond."""
+        if self._metrics is None:
+            return
+        self._metrics.adds.inc(1, self.name)
+        self._add_times.setdefault(item, self._clock())
+        self._metrics.depth.set(len(self._queue), self.name)
+
+    def _on_get(self, item: Hashable) -> None:
+        if self._metrics is None:
+            return
+        now = self._clock()
+        added_at = self._add_times.pop(item, None)
+        if added_at is not None:
+            self._metrics.queue_duration.observe(now - added_at, self.name)
+        self._start_times[item] = now
+        self._metrics.depth.set(len(self._queue), self.name)
+
+    def _on_done(self, item: Hashable) -> None:
+        if self._metrics is None:
+            return
+        started_at = self._start_times.pop(item, None)
+        if started_at is not None:
+            self._metrics.work_duration.observe(
+                self._clock() - started_at, self.name
+            )
+
+    def _update_unfinished_work(self) -> None:
+        with self._cond:
+            now = self._clock()
+            unfinished = sum(now - t for t in self._start_times.values())
+            self._metrics.unfinished_work.set(round(unfinished, 9), self.name)
 
     # -- core queue ------------------------------------------------------
 
@@ -80,6 +197,7 @@ class RateLimitingQueue:
                 return
             self._queued.add(item)
             self._queue.append(item)
+            self._on_enqueued(item)
             self._cond.notify()
 
     def add_after(self, item: Hashable, delay: float) -> None:
@@ -94,6 +212,8 @@ class RateLimitingQueue:
             self._cond.notify()
 
     def add_rate_limited(self, item: Hashable) -> None:
+        if self._metrics is not None:
+            self._metrics.retries.inc(1, self.name)
         self.add_after(item, self._rate_limiter.when(item))
 
     def forget(self, item: Hashable) -> None:
@@ -112,6 +232,7 @@ class RateLimitingQueue:
             elif item not in self._queued:
                 self._queued.add(item)
                 self._queue.append(item)
+                self._on_enqueued(item)
         if self._delayed:
             return self._delayed[0][0] - now
         return None
@@ -126,6 +247,7 @@ class RateLimitingQueue:
                     item = self._queue.pop(0)
                     self._queued.discard(item)
                     self._processing.add(item)
+                    self._on_get(item)
                     return item, False
                 if self._shutdown:
                     return None, True
@@ -140,11 +262,13 @@ class RateLimitingQueue:
     def done(self, item: Hashable) -> None:
         with self._cond:
             self._processing.discard(item)
+            self._on_done(item)
             if item in self._dirty:
                 self._dirty.discard(item)
                 if item not in self._queued:
                     self._queued.add(item)
                     self._queue.append(item)
+                    self._on_enqueued(item)
                     self._cond.notify()
 
     def shutdown(self) -> None:

@@ -1,0 +1,197 @@
+"""Training-loop telemetry: step wall time, throughput, and goodput.
+
+The scaling decisions in the source papers (arxiv 2011.03641, 1909.09756)
+all start from the same three numbers per run: how long a step takes, how
+many tokens/examples per second that buys, and what fraction of total wall
+time was *productive* step time (goodput) — the rest being compile,
+restart, checkpoint, and input stalls.  This module owns that bookkeeping
+for ``cmd.train``:
+
+- each step's wall time feeds a ``tpu_operator_train_step_duration_seconds``
+  histogram plus tokens/examples counters in a metrics registry (the same
+  registry shape the operator scrapes, so a sidecar exporter can serve it);
+- a compact JSONL record is emitted every ``interval`` steps (and on
+  ``close()``) to a file and/or stderr, one object per line, so progress is
+  greppable from pod logs without parsing the human log lines.
+
+Step durations are dispatch-to-dispatch wall times: JAX dispatch is async,
+so an individual step's number can lag its true device time, but the
+backpressure of a steady-state loop makes the sequence converge to real
+step time without forcing a device sync per step.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Callable, Optional, TextIO
+
+from . import metrics
+
+# Train steps range from ~1ms (tiny CPU models in tests) to minutes
+# (large pods): wider buckets than the server-latency defaults.
+STEP_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0,
+)
+
+
+class TrainingTelemetry:
+    """Accumulates per-step timings and derives throughput/goodput.
+
+    ``record_step`` is called once per optimizer step with that step's
+    wall time and whether it was warmup (warmup time counts toward total
+    wall time but not toward productive time, so compile cost lands in
+    the goodput denominator exactly once).
+    """
+
+    def __init__(
+        self,
+        *,
+        tokens_per_step: int = 0,
+        examples_per_step: int = 0,
+        registry: Optional[metrics.Registry] = None,
+        interval: int = 0,
+        jsonl_path: str = "",
+        stream: Optional[TextIO] = None,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
+        self.tokens_per_step = tokens_per_step
+        self.examples_per_step = examples_per_step
+        self.interval = interval
+        self._clock = clock
+        self._stream = stream if stream is not None else sys.stderr
+        self._file: Optional[TextIO] = None
+        if jsonl_path:
+            self._file = open(jsonl_path, "a", buffering=1)
+
+        registry = registry or metrics.DEFAULT_REGISTRY
+        self.registry = registry
+        self.step_duration = metrics.new_histogram(
+            "tpu_operator_train_step_duration_seconds",
+            "Wall time per optimizer step (dispatch-to-dispatch)",
+            registry=registry,
+            buckets=STEP_BUCKETS,
+        )
+        self.steps_total = metrics.new_counter(
+            "tpu_operator_train_steps_total",
+            "Optimizer steps completed, by phase",
+            ("phase",),
+            registry,
+        )
+        self.tokens_total = metrics.new_counter(
+            "tpu_operator_train_tokens_total",
+            "Tokens processed by post-warmup steps",
+            registry=registry,
+        )
+        self.examples_total = metrics.new_counter(
+            "tpu_operator_train_examples_total",
+            "Examples processed by post-warmup steps",
+            registry=registry,
+        )
+        self.goodput = metrics.new_gauge(
+            "tpu_operator_train_goodput_ratio",
+            "Productive step time over total wall time (compiles, restarts, "
+            "checkpoints included in the denominator)",
+            registry=registry,
+        )
+        self.throughput = metrics.new_gauge(
+            "tpu_operator_train_tokens_per_second",
+            "Recent tokens/second (examples/second for token-free models)",
+            registry=registry,
+        )
+
+        self._origin: Optional[float] = None
+        self._productive_s = 0.0
+        self._last_emit_step = 0
+        self._last_emit_time: Optional[float] = None
+        self._last_emit_productive = 0.0
+
+    def start(self, prior_wall_s: float = 0.0) -> None:
+        """Open the wall clock. ``prior_wall_s`` charges time spent before
+        this process's loop (e.g. restart/bootstrap cost carried across a
+        preemption) to the goodput denominator."""
+        self._origin = self._clock() - prior_wall_s
+        self._last_emit_time = self._clock()
+
+    def record_step(self, step: int, duration_s: float, *, warmup: bool = False) -> None:
+        if self._origin is None:
+            self.start()
+        self.step_duration.observe(duration_s)
+        self.steps_total.inc(1, "warmup" if warmup else "train")
+        if not warmup:
+            self._productive_s += duration_s
+            if self.tokens_per_step:
+                self.tokens_total.inc(self.tokens_per_step)
+            if self.examples_per_step:
+                self.examples_total.inc(self.examples_per_step)
+        if self.interval and step % self.interval == 0:
+            self.emit(step)
+
+    # -- derived numbers -------------------------------------------------
+
+    def wall_s(self) -> float:
+        if self._origin is None:
+            return 0.0
+        return max(self._clock() - self._origin, 1e-9)
+
+    def goodput_ratio(self) -> float:
+        wall = self.wall_s()
+        return min(self._productive_s / wall, 1.0) if wall > 0 else 0.0
+
+    def snapshot(self, step: int) -> dict:
+        """One JSONL record: cumulative ratios + rates over the window
+        since the previous emit (rates over the whole run would smear
+        every transient slowdown into invisibility)."""
+        now = self._clock()
+        window_s = (
+            now - self._last_emit_time
+            if self._last_emit_time is not None
+            else self.wall_s()
+        )
+        window_steps = step - self._last_emit_step
+        window_productive = self._productive_s - self._last_emit_productive
+        per_step = window_productive / window_steps if window_steps > 0 else 0.0
+        rate = window_steps / window_s if window_s > 0 else 0.0
+        goodput = self.goodput_ratio()
+        rec = {
+            "event": "train_telemetry",
+            "step": step,
+            "step_ms": round(per_step * 1000, 3),
+            "steps_per_sec": round(rate, 3),
+            "goodput": round(goodput, 4),
+            "wall_s": round(self.wall_s(), 3),
+        }
+        if self.tokens_per_step:
+            rec["tokens_per_sec"] = round(rate * self.tokens_per_step, 1)
+        if self.examples_per_step:
+            rec["examples_per_sec"] = round(rate * self.examples_per_step, 1)
+        self.goodput.set(round(goodput, 6))
+        self.throughput.set(
+            round(rate * (self.tokens_per_step or self.examples_per_step), 3)
+        )
+        self._last_emit_step = step
+        self._last_emit_time = now
+        self._last_emit_productive = self._productive_s
+        return rec
+
+    def emit(self, step: int) -> dict:
+        rec = self.snapshot(step)
+        line = json.dumps(rec, sort_keys=True)
+        if self._file is not None:
+            self._file.write(line + "\n")
+        else:
+            print(line, file=self._stream)
+        return rec
+
+    def close(self, step: int) -> Optional[dict]:
+        """Final emit (if enabled and a step landed since the last one),
+        then file close."""
+        rec = None
+        if self.interval and step > self._last_emit_step:
+            rec = self.emit(step)
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        return rec

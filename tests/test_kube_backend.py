@@ -653,7 +653,8 @@ class TestRestClientMetrics:
         kube = KubeAPIServer(RestConfig(host=frontend.url))
         registry = metrics_lib.Registry()
         c = metrics_lib.new_counter(
-            "tpu_operator_rest_client_retries_total", "retries", registry,
+            "tpu_operator_rest_client_retries_total", "retries",
+            registry=registry,
         )
         registry.on_scrape(lambda: c.mirror_total(kube.retry_count))
         try:

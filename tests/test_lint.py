@@ -100,3 +100,50 @@ def test_repo_is_clean():
         capture_output=True, text=True, cwd=repo,
     )
     assert out.returncode == 0, out.stdout[-2000:]
+
+
+def _registered_metric_names():
+    """(file, lineno, kind, name) for every literal metric registration
+    (new_counter/new_gauge/new_histogram call) in the package source."""
+    import ast
+
+    pkg = Path(__file__).resolve().parent.parent / "mpi_operator_tpu"
+    found = []
+    for path in sorted(pkg.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            callee = (
+                fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute)
+                else ""
+            )
+            if callee not in ("new_counter", "new_gauge", "new_histogram"):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            found.append(
+                (path.relative_to(pkg.parent), node.lineno, callee,
+                 node.args[0].value)
+            )
+    return found
+
+
+def test_metric_naming_conventions():
+    """Prometheus naming: one namespace prefix for the whole operator,
+    counters end in _total, histograms (base unit: seconds) in _seconds."""
+    registrations = _registered_metric_names()
+    assert len(registrations) >= 10, "metric registrations went missing"
+    bad = []
+    for file, line, kind, name in registrations:
+        where = f"{file}:{line} {kind}({name!r})"
+        if not name.startswith("tpu_operator_"):
+            bad.append(f"{where}: missing tpu_operator_ prefix")
+        if kind == "new_counter" and not name.endswith("_total"):
+            bad.append(f"{where}: counter must end in _total")
+        if kind == "new_histogram" and not name.endswith("_seconds"):
+            bad.append(f"{where}: histogram must end in _seconds")
+    assert not bad, "\n".join(bad)

@@ -1,0 +1,537 @@
+"""Observability layer tests: histograms, workqueue/reconcile metrics,
+span tracing, and training telemetry.
+
+The acceptance bar mirrors how Prometheus itself would see the operator:
+``Registry.expose()`` output is parsed line-by-line as text exposition
+format (HELP/TYPE headers, escaped label values, cumulative ``le``
+buckets), and the controller fixture drives a real reconcile so the
+scrape contains live workqueue + reconcile series, with the same cycle
+retrievable as spans from ``/debug/trace``.
+"""
+
+import io
+import json
+import re
+import urllib.request
+
+import pytest
+
+from mpi_operator_tpu.runtime.workqueue import RateLimitingQueue, WorkqueueMetrics
+from mpi_operator_tpu.utils import metrics, telemetry, trace
+
+from tests.test_controller import Fixture, make_synced_job
+
+
+# ---------------------------------------------------------------------------
+# Histogram primitive
+# ---------------------------------------------------------------------------
+
+
+class TestHistogram:
+    def test_cumulative_buckets_and_sum_count(self):
+        reg = metrics.Registry()
+        h = metrics.new_histogram(
+            "tpu_operator_test_seconds", "t", registry=reg, buckets=(0.1, 1.0, 5.0)
+        )
+        for v in (0.05, 0.5, 0.5, 3.0, 99.0):
+            h.observe(v)
+        # Cumulative: each bucket counts everything <= its bound.
+        assert h.cumulative_counts() == [1, 3, 4, 5]
+        assert h.sample_count() == 5
+        assert h.sample_sum() == pytest.approx(0.05 + 0.5 + 0.5 + 3.0 + 99.0)
+
+    def test_bucket_monotonicity_in_exposition(self):
+        reg = metrics.Registry()
+        h = metrics.new_histogram("tpu_operator_mono_seconds", "t", registry=reg)
+        for v in (0.001, 0.02, 0.3, 4.0, 100.0):
+            h.observe(v)
+        counts = [
+            float(line.rsplit(" ", 1)[1])
+            for line in reg.expose().splitlines()
+            if line.startswith("tpu_operator_mono_seconds_bucket")
+        ]
+        assert counts, "no bucket series exposed"
+        assert counts == sorted(counts), "cumulative buckets must be monotone"
+        assert counts[-1] == 5  # +Inf bucket sees every observation
+
+    def test_inf_bucket_equals_count(self):
+        reg = metrics.Registry()
+        h = metrics.new_histogram(
+            "tpu_operator_inf_seconds", "t", registry=reg, buckets=(1.0,)
+        )
+        h.observe(0.5)
+        h.observe(2.0)
+        text = reg.expose()
+        m = re.search(
+            r'tpu_operator_inf_seconds_bucket\{le="\+Inf"\} (\S+)', text
+        )
+        c = re.search(r"tpu_operator_inf_seconds_count (\S+)", text)
+        assert m and c and float(m.group(1)) == float(c.group(1)) == 2
+
+    def test_labels_partition_series(self):
+        reg = metrics.Registry()
+        h = metrics.new_histogram(
+            "tpu_operator_lbl_seconds", "t", ("result",), reg, buckets=(1.0,)
+        )
+        h.observe(0.5, "success")
+        h.observe(0.5, "error")
+        h.observe(0.7, "error")
+        assert h.sample_count("success") == 1
+        assert h.sample_count("error") == 2
+        text = reg.expose()
+        assert re.search(r'result="success",le="[^"]+"\} 1$', text, re.M)
+        assert re.search(r'result="error",le="\+Inf"\} 2$', text, re.M)
+
+    def test_time_context_manager(self):
+        reg = metrics.Registry()
+        h = metrics.new_histogram("tpu_operator_cm_seconds", "t", registry=reg)
+        with h.time():
+            pass
+        assert h.sample_count() == 1
+        assert h.sample_sum() >= 0.0
+
+    def test_empty_buckets_rejected(self):
+        reg = metrics.Registry()
+        with pytest.raises(ValueError):
+            metrics.new_histogram("tpu_operator_bad_seconds", "t",
+                                  registry=reg, buckets=())
+
+    def test_unsorted_buckets_are_sorted(self):
+        reg = metrics.Registry()
+        h = metrics.new_histogram(
+            "tpu_operator_sort_seconds", "t", registry=reg, buckets=(5.0, 0.1, 1.0)
+        )
+        h.observe(0.5)
+        assert h.cumulative_counts() == [0, 1, 1, 1]
+
+
+# ---------------------------------------------------------------------------
+# Exposition-format details (satellites: counter labels + label escaping)
+# ---------------------------------------------------------------------------
+
+
+class TestExposition:
+    def test_counter_accepts_label_names(self):
+        reg = metrics.Registry()
+        c = metrics.new_counter(
+            "tpu_operator_errs_total", "t", ("reason",), reg
+        )
+        c.inc(1, "TimeoutError")
+        c.inc(2, "ValueError")
+        text = reg.expose()
+        assert 'tpu_operator_errs_total{reason="TimeoutError"} 1' in text
+        assert 'tpu_operator_errs_total{reason="ValueError"} 2' in text
+
+    def test_label_value_escaping(self):
+        reg = metrics.Registry()
+        g = metrics.new_gauge("tpu_operator_esc", "t", ("who",), reg)
+        g.set(1, 'na"me\\x\n')
+        line = [
+            ln for ln in reg.expose().splitlines()
+            if ln.startswith("tpu_operator_esc{")
+        ][0]
+        assert line == 'tpu_operator_esc{who="na\\"me\\\\x\\n"} 1'
+
+    def test_help_escaping(self):
+        reg = metrics.Registry()
+        metrics.new_gauge("tpu_operator_h", "multi\nline \\ help", registry=reg)
+        assert "# HELP tpu_operator_h multi\\nline \\\\ help" in reg.expose()
+
+
+# ---------------------------------------------------------------------------
+# Workqueue instrumentation (client-go metric-set semantics)
+# ---------------------------------------------------------------------------
+
+
+class TestWorkqueueMetrics:
+    def _queue(self):
+        reg = metrics.Registry()
+        now = [0.0]
+        q = RateLimitingQueue(
+            clock=lambda: now[0], name="test", registry=reg
+        )
+        return q, reg, now
+
+    def test_depth_returns_to_zero_after_done(self):
+        q, _, _ = self._queue()
+        q.add("a")
+        q.add("b")
+        assert q.metrics.depth.value("test") == 2
+        assert q.get() == ("a", False)
+        assert q.metrics.depth.value("test") == 1
+        assert q.get() == ("b", False)
+        assert q.metrics.depth.value("test") == 0
+        q.done("a")
+        q.done("b")
+        assert q.metrics.depth.value("test") == 0
+
+    def test_dedup_does_not_count_as_add(self):
+        q, _, _ = self._queue()
+        q.add("a")
+        q.add("a")  # coalesced while queued
+        assert q.metrics.adds.value("test") == 1
+
+    def test_dirty_requeue_counts_as_add(self):
+        q, _, _ = self._queue()
+        q.add("a")
+        assert q.get() == ("a", False)
+        q.add("a")  # while processing -> dirty
+        q.done("a")  # re-queues the dirty item
+        assert q.metrics.adds.value("test") == 2
+        assert q.metrics.depth.value("test") == 1
+
+    def test_queue_and_work_durations(self):
+        q, _, now = self._queue()
+        q.add("a")
+        now[0] = 3.0  # queued 3s
+        assert q.get() == ("a", False)
+        now[0] = 5.0  # processed 2s
+        q.done("a")
+        assert q.metrics.queue_duration.sample_sum("test") == pytest.approx(3.0)
+        assert q.metrics.queue_duration.sample_count("test") == 1
+        assert q.metrics.work_duration.sample_sum("test") == pytest.approx(2.0)
+
+    def test_retries_total(self):
+        q, _, _ = self._queue()
+        q.add_rate_limited("a")
+        q.add_rate_limited("b")
+        assert q.metrics.retries.value("test") == 2
+
+    def test_unfinished_work_scrape_hook(self):
+        q, reg, now = self._queue()
+        q.add("a")
+        assert q.get() == ("a", False)
+        now[0] = 7.5  # still processing at scrape time
+        text = reg.expose()
+        m = re.search(
+            r'tpu_operator_workqueue_unfinished_work_seconds\{name="test"\} (\S+)',
+            text,
+        )
+        assert m and float(m.group(1)) == pytest.approx(7.5)
+        q.done("a")
+        text = reg.expose()
+        m = re.search(
+            r'tpu_operator_workqueue_unfinished_work_seconds\{name="test"\} (\S+)',
+            text,
+        )
+        assert m and float(m.group(1)) == 0.0
+
+    def test_shared_metrics_across_queues(self):
+        reg = metrics.Registry()
+        shared = WorkqueueMetrics(reg)
+        q1 = RateLimitingQueue(name="a", queue_metrics=shared)
+        q2 = RateLimitingQueue(name="b", queue_metrics=shared)
+        q1.add("x")
+        q2.add("y")
+        assert shared.adds.value("a") == 1
+        assert shared.adds.value("b") == 1
+
+
+# ---------------------------------------------------------------------------
+# Span tracer
+# ---------------------------------------------------------------------------
+
+
+class TestTracer:
+    def test_parent_child_and_trace_ids(self):
+        tr = trace.Tracer()
+        with tr.span("parent"):
+            with tr.span("child"):
+                pass
+        spans = tr.spans()
+        child = next(s for s in spans if s["name"] == "child")
+        parent = next(s for s in spans if s["name"] == "parent")
+        assert child["parent_id"] == parent["span_id"]
+        assert child["trace_id"] == parent["trace_id"] == parent["span_id"]
+        assert parent["parent_id"] is None
+
+    def test_error_capture_and_reraise(self):
+        tr = trace.Tracer()
+        with pytest.raises(ValueError):
+            with tr.span("boom"):
+                raise ValueError("nope")
+        (sp,) = tr.spans()
+        assert sp["error"] == "ValueError: nope"
+
+    def test_ring_buffer_caps(self):
+        tr = trace.Tracer(capacity=4)
+        for i in range(10):
+            with tr.span(f"s{i}"):
+                pass
+        assert len(tr) == 4
+        assert [s["name"] for s in tr.spans()] == ["s6", "s7", "s8", "s9"]
+
+    def test_jsonl_round_trip(self):
+        tr = trace.Tracer()
+        with tr.span("a", key="v"):
+            pass
+        lines = tr.to_jsonl().strip().splitlines()
+        assert len(lines) == 1
+        rec = json.loads(lines[0])
+        assert rec["name"] == "a" and rec["attrs"]["key"] == "v"
+
+    def test_threads_get_independent_stacks(self):
+        import threading
+
+        tr = trace.Tracer()
+        seen = {}
+
+        def worker():
+            with tr.span("in-thread"):
+                pass
+            seen["parent"] = tr.spans()[-1]["parent_id"]
+
+        with tr.span("main-root"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        # The thread's span must NOT adopt the main thread's root.
+        assert seen["parent"] is None
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: a reconcile cycle seen via scrape + /debug/trace
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})? "
+    r"(?P<value>[-+]?(?:[0-9.e+-]+|Inf|NaN))$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text):
+    """Minimal Prometheus text-format parser: returns (types, samples)
+    where samples is a list of (name, {label: value}, float)."""
+    types = {}
+    samples = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            assert line.startswith("# HELP "), f"bad comment line: {line!r}"
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        labels = dict(
+            (k, v) for k, v in _LABEL_RE.findall(m.group("labels") or "")
+        )
+        samples.append((m.group("name"), labels, float(m.group("value"))))
+    return types, samples
+
+
+WORKQUEUE_SET = (
+    "tpu_operator_workqueue_depth",
+    "tpu_operator_workqueue_adds_total",
+    "tpu_operator_workqueue_queue_duration_seconds",
+    "tpu_operator_workqueue_work_duration_seconds",
+    "tpu_operator_workqueue_unfinished_work_seconds",
+    "tpu_operator_workqueue_retries_total",
+)
+
+
+class TestReconcileObservability:
+    def _reconciled_fixture(self):
+        f = Fixture()
+        f.controller.tracer = trace.Tracer()
+        make_synced_job(f)
+        return f
+
+    def test_scrape_has_workqueue_set_and_reconcile_histogram(self):
+        f = self._reconciled_fixture()
+        # Route the key through the queue so queue/work durations fire.
+        f.controller.queue.add("default/test-job")
+        key, _ = f.controller.queue.get()
+        f.controller.sync_handler(key)
+        f.controller.queue.done(key)
+
+        types, samples = parse_exposition(f.controller.registry.expose())
+        names = {s[0] for s in samples}
+        for metric in WORKQUEUE_SET:
+            assert types.get(metric), f"missing TYPE for {metric}"
+        assert types["tpu_operator_workqueue_depth"] == "gauge"
+        assert types["tpu_operator_workqueue_adds_total"] == "counter"
+        assert types["tpu_operator_workqueue_queue_duration_seconds"] == "histogram"
+        assert "tpu_operator_workqueue_adds_total" in names
+        assert "tpu_operator_workqueue_queue_duration_seconds_bucket" in names
+
+        # Reconcile latency histogram with a success outcome.
+        assert types["tpu_operator_reconcile_duration_seconds"] == "histogram"
+        count = [
+            v for n, lab, v in samples
+            if n == "tpu_operator_reconcile_duration_seconds_count"
+            and lab.get("result") == "success"
+        ]
+        assert count and count[0] >= 1
+
+        # Histogram structural invariants, for every histogram scraped.
+        for hist in [n for n, kind in types.items() if kind == "histogram"]:
+            series = {}
+            for n, lab, v in samples:
+                if n == hist + "_bucket":
+                    key = tuple(sorted(
+                        (k, val) for k, val in lab.items() if k != "le"
+                    ))
+                    series.setdefault(key, []).append((lab["le"], v))
+            for key, buckets in series.items():
+                vals = [v for _, v in buckets]
+                assert vals == sorted(vals), f"{hist}{key} not cumulative"
+                assert buckets[-1][0] == "+Inf"
+
+    def test_condition_transition_timestamps(self):
+        f = self._reconciled_fixture()
+        _, samples = parse_exposition(f.controller.registry.expose())
+        created = [
+            (lab, v) for n, lab, v in samples
+            if n == "tpu_operator_job_condition_transition_timestamp_seconds"
+            and lab.get("type") == "Created"
+        ]
+        assert created and created[0][0]["tpujob"] == "test-job"
+        assert created[0][1] == f.time[0]
+
+    def test_reconcile_error_counted_by_reason(self):
+        f = self._reconciled_fixture()
+
+        def boom(key):
+            raise RuntimeError("kaput")
+
+        f.controller._sync_job = boom
+        with pytest.raises(RuntimeError):
+            f.controller.sync_handler("default/test-job")
+        assert f.controller.sync_errors.value("RuntimeError") == 1
+        assert f.controller.sync_duration.sample_count("error") == 1
+
+    def test_trace_of_one_reconcile_cycle(self):
+        f = self._reconciled_fixture()
+        spans = f.controller.tracer.spans()
+        reconcile = [s for s in spans if s["name"] == "reconcile"]
+        assert reconcile, "sync_handler must open a reconcile span"
+        root = reconcile[0]
+        children = [s for s in spans if s["trace_id"] == root["trace_id"]]
+        names = {s["name"] for s in children}
+        # Builders nest under the reconcile that invoked them.
+        assert any(n.startswith("builders.") for n in names), names
+        for s in children:
+            if s["name"].startswith("builders."):
+                assert s["attrs"]["job"] == "default/test-job"
+
+    def test_debug_trace_endpoint(self):
+        from http.server import ThreadingHTTPServer
+
+        from mpi_operator_tpu.cmd.operator import _MonitoringHandler
+
+        f = self._reconciled_fixture()
+        handler = type(
+            "H",
+            (_MonitoringHandler,),
+            {
+                "registry": f.controller.registry,
+                "tracer": f.controller.tracer,
+                "health_fn": staticmethod(lambda: True),
+            },
+        )
+        server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        import threading
+
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            port = server.server_address[1]
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/trace", timeout=5
+            ).read().decode()
+            recs = [json.loads(ln) for ln in body.strip().splitlines()]
+            assert any(r["name"] == "reconcile" for r in recs)
+            scrape = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode()
+            types, _ = parse_exposition(scrape)
+            assert types.get("tpu_operator_reconcile_duration_seconds") == "histogram"
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Training telemetry
+# ---------------------------------------------------------------------------
+
+
+class TestTrainingTelemetry:
+    def _telem(self, **kw):
+        t = [100.0]
+        buf = io.StringIO()
+        kw.setdefault("registry", metrics.Registry())
+        tm = telemetry.TrainingTelemetry(
+            stream=buf, clock=lambda: t[0], **kw
+        )
+        return tm, t, buf
+
+    def test_goodput_excludes_warmup_from_numerator(self):
+        tm, t, _ = self._telem()
+        tm.start()
+        t[0] += 2.0
+        tm.record_step(1, 2.0, warmup=True)  # compile
+        t[0] += 1.0
+        tm.record_step(2, 1.0)
+        assert tm.goodput_ratio() == pytest.approx(1.0 / 3.0)
+
+    def test_jsonl_every_interval(self):
+        tm, t, buf = self._telem(interval=2, tokens_per_step=100)
+        tm.start()
+        for step in range(1, 5):
+            t[0] += 0.1
+            tm.record_step(step, 0.1)
+        recs = [json.loads(ln) for ln in buf.getvalue().strip().splitlines()]
+        assert [r["step"] for r in recs] == [2, 4]
+        assert recs[0]["event"] == "train_telemetry"
+        assert recs[1]["tokens_per_sec"] == pytest.approx(1000.0, rel=0.01)
+        assert 0.0 < recs[1]["goodput"] <= 1.0
+
+    def test_close_emits_tail_only_when_enabled(self):
+        tm, t, buf = self._telem(interval=2)
+        tm.start()
+        t[0] += 0.1
+        tm.record_step(1, 0.1)
+        tm.close(1)
+        assert buf.getvalue().count("train_telemetry") == 1
+        tm2, t2, buf2 = self._telem(interval=0)
+        tm2.start()
+        t2[0] += 0.1
+        tm2.record_step(1, 0.1)
+        tm2.close(1)
+        assert buf2.getvalue() == ""
+
+    def test_metrics_registered(self):
+        reg = metrics.Registry()
+        tm, t, _ = self._telem(registry=reg, tokens_per_step=10,
+                               examples_per_step=2)
+        tm.start()
+        t[0] += 0.5
+        tm.record_step(1, 0.5)
+        tm.snapshot(1)
+        text = reg.expose()
+        assert "tpu_operator_train_step_duration_seconds_bucket" in text
+        assert 'tpu_operator_train_steps_total{phase="train"} 1' in text
+        assert "tpu_operator_train_tokens_total 10" in text
+        assert "tpu_operator_train_goodput_ratio" in text
+        assert "tpu_operator_train_tokens_per_second" in text
+
+    def test_jsonl_file_sink(self, tmp_path):
+        path = tmp_path / "telemetry.jsonl"
+        t = [0.0]
+        tm = telemetry.TrainingTelemetry(
+            registry=metrics.Registry(), interval=1,
+            jsonl_path=str(path), clock=lambda: t[0],
+        )
+        tm.start()
+        t[0] += 0.2
+        tm.record_step(1, 0.2)
+        tm.close(1)
+        recs = [json.loads(ln) for ln in path.read_text().strip().splitlines()]
+        assert recs and recs[0]["step"] == 1
