@@ -185,3 +185,48 @@ def resolve(accelerator_type: str, topology: str = "") -> SliceShape:
         num_hosts=num_hosts,
         chips_per_host=chips_per_host,
     )
+
+
+def host_block_dims(dims: tuple[int, ...]) -> tuple[int, ...]:
+    """Extents of one host's chip block within a multi-host slice.
+
+    A host owns a 2x2 block; in 3D the two "2" extents lie along the
+    first two *even* dimensions (2x2x1 canonically, but e.g. a 2x3x2
+    slice tiles as 2x1x2 blocks — chip divisibility alone does not pin
+    the orientation).
+    """
+    evens = [i for i, d in enumerate(dims) if d % 2 == 0][:2]
+    return tuple(2 if i in evens else 1 for i in range(len(dims)))
+
+
+def host_grid(shape: SliceShape) -> list[tuple[int, ...]]:
+    """Chip-space origin of every host's block, indexed by host id.
+
+    Host ids walk the block grid in row-major order, so consecutive ids
+    are physically adjacent along the innermost dimension — the property
+    the scheduler's topology-aware scoring relies on when it packs a
+    gang onto contiguous hosts of one slice.
+    """
+    dims = shape.dims()
+    if shape.num_hosts == 1:
+        return [tuple(0 for _ in dims)]
+    block = host_block_dims(dims)
+    counts = tuple(d // b for d, b in zip(dims, block))
+    coords: list[tuple[int, ...]] = []
+    for idx in range(shape.num_hosts):
+        rem, pos = idx, []
+        for c in reversed(counts):
+            pos.append(rem % c)
+            rem //= c
+        coords.append(tuple(p * b for p, b in zip(reversed(pos), block)))
+    return coords
+
+
+def resolve_shape_or_none(accelerator_type: str, topology: str = ""):
+    """``resolve`` that returns None instead of raising — the scheduler
+    consumes inventory/pod hints best-effort and must not crash on a
+    malformed one."""
+    try:
+        return resolve(accelerator_type, topology)
+    except TopologyError:
+        return None

@@ -54,6 +54,7 @@ CLEAN_POD_POLICY_ALL = "All"
 # Job condition types (kubeflow-common analog, consumed by
 # mpi_job_controller_status.go).
 JOB_CREATED = "Created"
+JOB_SCHEDULED = "Scheduled"
 JOB_RUNNING = "Running"
 JOB_RESTARTING = "Restarting"
 JOB_SUSPENDED = "Suspended"

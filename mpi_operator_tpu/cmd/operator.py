@@ -60,6 +60,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "kubelet probes can reach /healthz)")
     p.add_argument("--gang-scheduling", default="",
                    help="gang scheduler name (e.g. volcano); empty disables")
+    p.add_argument("--enable-scheduler", action="store_true",
+                   help="run the in-process gang scheduler (memory backend "
+                        "only): pods start Pending and are bound "
+                        "all-or-nothing per gang; off = pods auto-bind on "
+                        "creation (pre-scheduler behaviour)")
+    p.add_argument("--node-inventory", default="v5p-8:2,v5e-16:2",
+                   help="TPU node inventory for the scheduler, "
+                        "'accelType[/topology][:count],...' "
+                        "(e.g. 'v5e-16:2,v4-32'); one Node per TPU host. "
+                        "The default fits the shipped examples; a gang "
+                        "whose acceleratorType matches no slice stays "
+                        "Unschedulable until the inventory does")
     p.add_argument("--leader-elect", action="store_true",
                    help="enable leader election for HA deployments")
     p.add_argument("--lock-namespace", default="default",
@@ -177,7 +189,9 @@ def build_backend(args):
             qps=args.kube_api_qps, burst=args.kube_api_burst,
         ), None
     api = InMemoryAPIServer()
-    return api, LocalPodRunner(api)
+    # With the in-process scheduler on, the kubelet sim stops playing
+    # scheduler: it only launches pods something has bound.
+    return api, LocalPodRunner(api, auto_bind=not args.enable_scheduler)
 
 
 def _ua() -> str:
@@ -188,6 +202,13 @@ def _ua() -> str:
 
 def run(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.enable_scheduler and args.backend != "memory":
+        print(
+            "--enable-scheduler requires --backend memory (a real cluster "
+            "brings its own scheduler)",
+            file=sys.stderr,
+        )
+        return 1
 
     api, runner = build_backend(args)
     check_crd_exists(api, args.namespace)
@@ -213,6 +234,21 @@ def run(argv=None) -> int:
             rest_retries.mirror_total(api.retry_count),
             rest_throttle.mirror_total(round(api.throttle_wait, 3)),
         ))
+    scheduler = None
+    if args.enable_scheduler:
+        from ..scheduler import DEFAULT_SCHEDULER_NAME, GangScheduler, register_nodes
+
+        nodes = register_nodes(api, args.node_inventory)
+        print(
+            f"scheduler: registered {len(nodes)} TPU host node(s) from "
+            f"inventory {args.node_inventory!r}"
+        )
+        scheduler = GangScheduler(api, registry=registry)
+        # Workers must carry the gang annotation + schedulerName for
+        # all-or-nothing admission; default it when the user didn't pick
+        # an external gang scheduler explicitly.
+        if not args.gang_scheduling:
+            args.gang_scheduling = DEFAULT_SCHEDULER_NAME
     controller = TPUJobController(
         api,
         namespace=args.namespace,
@@ -222,6 +258,8 @@ def run(argv=None) -> int:
     # Controller metrics share the exposed registry.
     if runner is not None:
         runner.start()
+    if scheduler is not None:
+        scheduler.start()
 
     applied: list[tuple[str, str]] = []
     import yaml
@@ -336,12 +374,16 @@ def run(argv=None) -> int:
                             f"TPUJob {ns}/{name}: {final['type']} ({final.get('reason', '')})"
                         )
                     stop.set()
+                    if scheduler is not None:
+                        scheduler.stop()
                     if runner is not None:
                         runner.stop()
                     return 0 if all(f["type"] == "Succeeded" for _, _, f in finals) else 1
             time.sleep(poll_interval)
     except KeyboardInterrupt:
         stop.set()
+    if scheduler is not None:
+        scheduler.stop()
     if runner is not None:
         runner.stop()
     return 0

@@ -33,6 +33,9 @@ TPUJOB_EVICTED_REASON = "TPUJobEvicted"
 TPUJOB_RESTARTING_REASON = "TPUJobRestarting"
 TPUJOB_SUSPENDED_REASON = "TPUJobSuspended"
 TPUJOB_RESUMED_REASON = "TPUJobResumed"
+# Gang-scheduler surfacing (kube-scheduler vocabulary, not kubeflow's).
+TPUJOB_SCHEDULED_REASON = "TPUJobScheduled"
+TPUJOB_UNSCHEDULABLE_REASON = "Unschedulable"
 
 CONDITION_TRUE = "True"
 CONDITION_FALSE = "False"

@@ -34,6 +34,7 @@ from ..api.v2beta1.types import (
     JOB_FAILED,
     JOB_RESTARTING,
     JOB_RUNNING,
+    JOB_SCHEDULED,
     JOB_SUCCEEDED,
     JOB_SUSPENDED,
     KIND,
@@ -54,7 +55,14 @@ from ..runtime.informer import EventHandler, InformerFactory, meta_namespace_key
 from ..runtime.objects import KubeObject
 from ..runtime.workqueue import RateLimitingQueue
 from ..utils import metrics, trace
-from ..utils.events import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING, EventRecorder, truncate_message
+from ..utils.events import (
+    EVENT_TYPE_NORMAL,
+    EVENT_TYPE_WARNING,
+    FAILED_SCHEDULING_REASON,
+    SCHEDULED_REASON,
+    EventRecorder,
+    truncate_message,
+)
 from . import builders, status as st
 
 # Event reasons (mpi_job_controller.go:90-103 analog).
@@ -1009,6 +1017,8 @@ class TPUJobController:
                 job.status.completion_time = now
             self.jobs_failed.inc()
 
+        self._surface_scheduling(job, workers, now)
+
         has_launcher_spec = REPLICA_TYPE_LAUNCHER in job.spec.replica_specs
         replicas = builders.worker_replicas(job)
 
@@ -1104,6 +1114,53 @@ class TPUJobController:
 
         if job.status.to_dict() != old_status:
             self.update_status_handler(job)
+
+    def _surface_scheduling(
+        self, job: TPUJob, workers: list[dict], now: float
+    ) -> None:
+        """Fold the gang scheduler's per-pod ``PodScheduled`` conditions
+        into one job-level ``Scheduled`` condition + kube-style events.
+
+        Auto-bind mode leaves pods condition-free, so this is a no-op for
+        every pre-scheduler deployment — no status churn, no new events.
+        """
+        pod_conds: list[dict] = []
+        for pod in workers:
+            for cond in (pod.get("status") or {}).get("conditions") or []:
+                if cond.get("type") == "PodScheduled":
+                    pod_conds.append(cond)
+        if not pod_conds:
+            return
+        unsched = [c for c in pod_conds if c.get("status") != st.CONDITION_TRUE]
+        if unsched:
+            msg = truncate_message(
+                unsched[0].get("message")
+                or f"TPUJob {job.namespace}/{job.name} has unschedulable workers"
+            )
+            prev = st.get_condition(job.status, JOB_SCHEDULED)
+            self._set_condition(
+                job,
+                JOB_SCHEDULED,
+                st.TPUJOB_UNSCHEDULABLE_REASON,
+                msg,
+                status=st.CONDITION_FALSE,
+                now=now,
+            )
+            if prev is None or prev.status != st.CONDITION_FALSE:
+                self.recorder.event(
+                    job, EVENT_TYPE_WARNING, FAILED_SCHEDULING_REASON, msg
+                )
+        elif len(pod_conds) == len(workers):
+            already = st.has_condition(job.status, JOB_SCHEDULED)
+            msg = (
+                f"all {len(workers)} workers of TPUJob "
+                f"{job.namespace}/{job.name} are assigned to nodes"
+            )
+            self._set_condition(
+                job, JOB_SCHEDULED, st.TPUJOB_SCHEDULED_REASON, msg, now=now
+            )
+            if not already:
+                self.recorder.event(job, EVENT_TYPE_NORMAL, SCHEDULED_REASON, msg)
 
     def _update_job_failed_status(
         self, job: TPUJob, launcher: dict, launcher_pods: list[dict], now: float

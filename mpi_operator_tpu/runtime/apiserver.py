@@ -89,6 +89,7 @@ class ResourceType:
 RESOURCES: dict[str, ResourceType] = {
     r.plural: r
     for r in [
+        ResourceType("nodes", "v1", "Node"),
         ResourceType("pods", "v1", "Pod"),
         ResourceType("services", "v1", "Service"),
         ResourceType("configmaps", "v1", "ConfigMap"),

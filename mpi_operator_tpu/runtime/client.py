@@ -64,6 +64,10 @@ class KubeClient:
     def pods(self, namespace: str) -> ResourceClient:
         return ResourceClient(self.api, "pods", namespace)
 
+    def nodes(self) -> ResourceClient:
+        # Nodes are cluster-scoped: the empty namespace is their home.
+        return ResourceClient(self.api, "nodes", "")
+
     def services(self, namespace: str) -> ResourceClient:
         return ResourceClient(self.api, "services", namespace)
 

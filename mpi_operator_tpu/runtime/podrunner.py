@@ -30,9 +30,20 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-from .apiserver import ADDED, DELETED, InMemoryAPIServer, NotFoundError
+from .apiserver import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    ConflictError,
+    InMemoryAPIServer,
+    NotFoundError,
+)
 
 MAX_RESTARTS = 3
+
+# The nodeName auto-bind mode stamps when no scheduler is running: the
+# simulator's single implicit machine.
+DEFAULT_NODE_NAME = "local-node"
 
 
 @dataclass
@@ -49,10 +60,18 @@ class LocalPodRunner:
         *,
         base_env: Optional[dict[str, str]] = None,
         workdir: Optional[str] = None,
+        auto_bind: bool = True,
+        node_name: str = DEFAULT_NODE_NAME,
     ):
         self.api = api
         self.base_env = base_env or {}
         self.workdir = workdir or os.getcwd()
+        # A kubelet only runs pods bound to its node.  With no scheduler in
+        # the process (the default), the runner plays scheduler too and
+        # auto-binds unbound pods to its own node; with ``auto_bind=False``
+        # it strictly waits for ``spec.nodeName`` (gang-scheduler mode).
+        self.auto_bind = auto_bind
+        self.node_name = node_name
         self._pods: dict[tuple[str, str], RunningPod] = {}
         self._job_pods: dict[tuple[str, str], int] = {}  # job -> failures so far
         self._lock = threading.RLock()
@@ -94,7 +113,10 @@ class LocalPodRunner:
             for event in self._pod_watch.drain():
                 progressed = True
                 key = self._event_key(event.object)
-                if event.type == ADDED:
+                if event.type in (ADDED, MODIFIED):
+                    # MODIFIED matters in scheduler mode: the bind that
+                    # stamps spec.nodeName arrives as an update, not a
+                    # create. _maybe_start_pod is idempotent per pod.
                     self._maybe_start_pod(event.object)
                 elif event.type == DELETED:
                     self._kill(key)
@@ -151,8 +173,42 @@ class LocalPodRunner:
             cmd[0] = sys.executable
         return cmd
 
+    def _ensure_bound(self, pod: dict) -> Optional[dict]:
+        """Return a pod bound to a node, auto-binding if this runner plays
+        scheduler; None if the pod must keep waiting for a bind."""
+        if pod["spec"].get("nodeName"):
+            return pod
+        if not self.auto_bind:
+            return None
+        key = self._event_key(pod)
+        for _ in range(2):  # one conflict retry, then next watch event
+            try:
+                fresh = self.api.get("pods", key[0], key[1])
+            except NotFoundError:
+                return None
+            if fresh["spec"].get("nodeName"):
+                return fresh
+            fresh["spec"]["nodeName"] = self.node_name
+            try:
+                return self.api.update("pods", fresh)
+            except ConflictError:
+                continue
+        return None
+
     def _maybe_start_pod(self, pod: dict) -> None:
         key = self._event_key(pod)
+        with self._lock:
+            if key in self._pods:
+                return
+        # A pod we are not tracking but whose phase already progressed is
+        # one we (or a previous runner) finished or are mid-reaping —
+        # MODIFIED events from our own status writes must not relaunch it.
+        if (pod.get("status") or {}).get("phase") in ("Running", "Succeeded", "Failed"):
+            return
+        bound = self._ensure_bound(pod)
+        if bound is None:
+            return
+        pod = bound
         with self._lock:
             if key in self._pods:
                 return
@@ -235,7 +291,10 @@ class LocalPodRunner:
             pod = self.api.get("pods", key[0], key[1])
         except NotFoundError:
             return
-        status = {"phase": phase}
+        # Merge, don't replace: the scheduler's PodScheduled condition must
+        # survive the phase flip.
+        status = dict(pod.get("status") or {})
+        status["phase"] = phase
         if reason:
             status["reason"] = reason
         if message:

@@ -16,8 +16,27 @@ from typing import Any
 EVENT_TYPE_NORMAL = "Normal"
 EVENT_TYPE_WARNING = "Warning"
 
+# Scheduler event reasons (kube-scheduler vocabulary).
+SCHEDULED_REASON = "Scheduled"
+FAILED_SCHEDULING_REASON = "FailedScheduling"
+PREEMPTED_REASON = "Preempted"
+
 # eventMessageLimit, mpi_job_controller.go:116 analog.
 MESSAGE_LIMIT = 1024
+
+
+def format_failed_scheduling(total_nodes: int, reasons) -> str:
+    """Render kube-scheduler's FailedScheduling message shape:
+    ``0/4 nodes are available: 3 Insufficient google.com/tpu, 1 node(s)
+    had mismatched TPU generation.`` — ``reasons`` is a mapping of
+    reason string -> node count."""
+    if not reasons:
+        detail = "no nodes registered" if total_nodes == 0 else "no reason recorded"
+        return f"0/{total_nodes} nodes are available: {detail}."
+    parts = ", ".join(
+        f"{count} {reason}" for reason, count in sorted(reasons.items())
+    )
+    return f"0/{total_nodes} nodes are available: {parts}."
 
 
 def truncate_message(message: str) -> str:

@@ -153,3 +153,130 @@ class TestPartition:
         assert time.time() - t0 < 45, "partition was not detected in bounded time"
         cond = [c for c in job["status"]["conditions"] if c["type"] == "Failed"][0]
         assert "partition-worker" in cond["message"]
+
+
+def _gang(api, name, members=4, chips=4):
+    from mpi_operator_tpu.scheduler import DEFAULT_SCHEDULER_NAME, GROUP_ANNOTATION
+
+    api.create(
+        "podgroups",
+        {
+            "apiVersion": "scheduling.x-k8s.io/v1alpha1",
+            "kind": "PodGroup",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"minMember": members},
+        },
+    )
+    for i in range(members):
+        api.create(
+            "pods",
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": f"{name}-{i}",
+                    "namespace": "default",
+                    "annotations": {GROUP_ANNOTATION: name},
+                },
+                "spec": {
+                    "schedulerName": DEFAULT_SCHEDULER_NAME,
+                    "containers": [
+                        {"resources": {"requests": {"google.com/tpu": chips}}}
+                    ],
+                },
+            },
+        )
+
+
+def _assert_no_leak(scheduler, api):
+    """The ledger invariant after any pass, fault or not: nothing stays
+    reserved, and per-node accounting exactly mirrors live bound pods."""
+    cache = scheduler.cache
+    assert cache.total_reserved() == 0
+    live = {}
+    for pod in api.list("pods", None):
+        node = (pod.get("spec") or {}).get("nodeName")
+        if node and (pod.get("status") or {}).get("phase") not in (
+            "Succeeded",
+            "Failed",
+        ):
+            req = pod["spec"]["containers"][0]["resources"]["requests"]
+            live[node] = live.get(node, 0) + int(req["google.com/tpu"])
+    for node in cache.nodes.values():
+        assert node.allocated == live.get(node.name, 0), node.name
+        assert 0 <= node.free <= node.capacity, node.name
+
+
+class TestFlakyBinderRollback:
+    """Scheduler-tier fault injection (no subprocesses): bind conflicts
+    and node loss mid-reserve must roll the gang back without leaking a
+    single chip from the scheduler's ledger."""
+
+    def _scheduler(self, inventory="v5e-16:2"):
+        from mpi_operator_tpu.scheduler import (
+            Binder,
+            FlakyBinder,
+            GangScheduler,
+            register_nodes,
+        )
+
+        api = InMemoryAPIServer()
+        register_nodes(api, inventory)
+        flaky = FlakyBinder(Binder(api))
+        scheduler = GangScheduler(api, binder=flaky)
+        return api, scheduler, flaky
+
+    def test_bind_conflict_mid_gang_rolls_back_and_retries(self):
+        api, scheduler, flaky = self._scheduler()
+        flaky.fail_calls = {3}  # third member's bind conflicts
+        _gang(api, "gang")
+        out = scheduler.schedule_once()
+        assert out["bound"] == 0 and out["pending_gangs"] == 1
+        # Two members really bound before the fault; the rest rolled back.
+        bound = [
+            p for p in api.list("pods", None) if (p["spec"].get("nodeName"))
+        ]
+        assert len(bound) == 2
+        _assert_no_leak(scheduler, api)
+        # The fault was transient: the next pass completes the gang.
+        assert scheduler.schedule_once()["bound"] == 2
+        assert all(p["spec"].get("nodeName") for p in api.list("pods", None))
+        _assert_no_leak(scheduler, api)
+        assert flaky.calls == 5
+
+    def test_node_loss_mid_reserve_never_leaks_chips(self):
+        api, scheduler, flaky = self._scheduler()
+
+        def lose_node(call, namespace, name, node_name):
+            api.delete("nodes", "", node_name)
+
+        flaky.fail_calls = {2}
+        flaky.on_fail = lose_node
+        _gang(api, "gang")
+        out = scheduler.schedule_once()
+        assert out["bound"] == 0
+        _assert_no_leak(scheduler, api)
+        # The lost node is gone from the capacity model entirely...
+        flaky.fail_calls = set()
+        scheduler.schedule_once()
+        assert len(scheduler.cache.nodes) == 7
+        # ...and the gang eventually lands whole on surviving hosts.
+        deadline_passes = 3
+        for _ in range(deadline_passes):
+            scheduler.schedule_once()
+        pods = api.list("pods", None)
+        assert all(p["spec"].get("nodeName") for p in pods)
+        # Nobody landed on a node that no longer exists.
+        live_nodes = {n["metadata"]["name"] for n in api.list("nodes", None)}
+        assert all(p["spec"]["nodeName"] in live_nodes for p in pods)
+        _assert_no_leak(scheduler, api)
+
+    def test_every_call_failing_parks_gang_without_leak(self):
+        api, scheduler, flaky = self._scheduler("v5e-16:1")
+        flaky.fail_calls = set(range(1, 100))
+        _gang(api, "gang")
+        for _ in range(3):
+            out = scheduler.schedule_once()
+            assert out["bound"] == 0
+            _assert_no_leak(scheduler, api)
+        assert all("nodeName" not in p["spec"] for p in api.list("pods", None))

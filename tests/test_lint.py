@@ -147,3 +147,61 @@ def test_metric_naming_conventions():
         if kind == "new_histogram" and not name.endswith("_seconds"):
             bad.append(f"{where}: histogram must end in _seconds")
     assert not bad, "\n".join(bad)
+
+
+def test_scheduler_metrics_carry_subsystem_prefix():
+    """Every metric registered under mpi_operator_tpu/scheduler/ must use
+    the tpu_operator_scheduler_ subsystem prefix (so dashboards can
+    select the scheduler's series with one matcher), and the scheduler
+    must register its whole advertised quartet."""
+    scheduler_metrics = [
+        (file, line, kind, name)
+        for file, line, kind, name in _registered_metric_names()
+        if str(file).replace("\\", "/").startswith("mpi_operator_tpu/scheduler/")
+    ]
+    assert scheduler_metrics, "scheduler metric registrations went missing"
+    bad = [
+        f"{file}:{line} {kind}({name!r}): missing tpu_operator_scheduler_ prefix"
+        for file, line, kind, name in scheduler_metrics
+        if not name.startswith("tpu_operator_scheduler_")
+    ]
+    assert not bad, "\n".join(bad)
+    names = {name for _, _, _, name in scheduler_metrics}
+    assert {
+        "tpu_operator_scheduler_scheduling_duration_seconds",
+        "tpu_operator_scheduler_pending_gangs",
+        "tpu_operator_scheduler_binds_total",
+        "tpu_operator_scheduler_preemptions_total",
+    } <= names
+
+
+def test_scheduler_plugins_expose_framework_interface():
+    """Every concrete plugin in scheduler/plugins.py must carry the
+    framework surface — a distinct ``name`` and callable ``filter`` and
+    ``score`` — so the core can run any registered plugin uniformly."""
+    import inspect
+
+    from mpi_operator_tpu.scheduler import plugins as plugin_mod
+
+    concrete = [
+        cls
+        for _, cls in inspect.getmembers(plugin_mod, inspect.isclass)
+        if issubclass(cls, plugin_mod.Plugin) and cls is not plugin_mod.Plugin
+        and cls.__module__ == plugin_mod.__name__
+    ]
+    assert len(concrete) >= 3, "scheduler plugins went missing"
+    names = set()
+    for cls in concrete:
+        assert isinstance(cls.name, str) and cls.name, cls
+        assert cls.name != plugin_mod.Plugin.name, f"{cls}: default name"
+        names.add(cls.name)
+        for method in ("filter", "score"):
+            fn = getattr(cls, method)
+            assert callable(fn), f"{cls}.{method} not callable"
+            params = list(inspect.signature(fn).parameters)
+            assert params == ["self", "ctx", "pod", "node"], (
+                f"{cls.__name__}.{method} signature {params}"
+            )
+    assert len(names) == len(concrete), "plugin names must be distinct"
+    # The default pipeline is built from these plugins.
+    assert {p.name for p in plugin_mod.DEFAULT_PLUGINS} <= names

@@ -1,0 +1,523 @@
+"""Gang scheduler tests: capacity model, all-or-nothing admission,
+priority preemption, topology packing, and the controller surfacing.
+
+Analog of kube's scheduler_test.go + scheduler-plugins' coscheduling
+integration tests, driven synchronously: ``schedule_once`` is one
+scheduling frame, controller syncs are pumped by hand, and a list-based
+clock makes waitlist timeouts deterministic.
+"""
+
+import pytest
+
+from mpi_operator_tpu.api.v2beta1 import (
+    REPLICA_TYPE_WORKER,
+    ReplicaSpec,
+    SchedulingPolicy,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+)
+from mpi_operator_tpu.controller import status as st
+from mpi_operator_tpu.controller.tpu_job_controller import TPUJobController
+from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer
+from mpi_operator_tpu.scheduler import (
+    DEFAULT_SCHEDULER_NAME,
+    GROUP_ANNOTATION,
+    GangScheduler,
+    InventoryError,
+    NodeInfo,
+    SchedulerCache,
+    SchedulingContext,
+    TopologyPackPlugin,
+    TPUCapacityPlugin,
+    build_nodes,
+    parse_inventory,
+    register_nodes,
+)
+
+NOW = 1000.0
+TEMPLATE = {"spec": {"containers": [{"name": "main", "image": "tpu-image"}]}}
+
+
+class Cluster:
+    """API server + controller + scheduler on one injectable clock; no
+    pod runner — pods stay in the phase the scheduler leaves them in."""
+
+    def __init__(self, inventory="v5e-16:1"):
+        self.time = [NOW]
+        clock = lambda: self.time[0]  # noqa: E731
+        self.api = InMemoryAPIServer(clock=clock)
+        register_nodes(self.api, inventory)
+        self.controller = TPUJobController(
+            self.api, gang_scheduler_name=DEFAULT_SCHEDULER_NAME, clock=clock
+        )
+        self.scheduler = GangScheduler(self.api, clock=clock)
+        self.controller.start()
+
+    def new_job(self, name, priority_class=""):
+        job = TPUJob()
+        job.metadata.name = name
+        job.metadata.namespace = "default"
+        job.spec = TPUJobSpec(
+            tpu=TPUSpec(accelerator_type="v5e-16"),
+            replica_specs={
+                REPLICA_TYPE_WORKER: ReplicaSpec(replicas=4, template=dict(TEMPLATE))
+            },
+        )
+        if priority_class:
+            job.spec.run_policy.scheduling_policy = SchedulingPolicy(
+                priority_class=priority_class
+            )
+        return self.controller.tpujobs.tpujobs("default").create(job)
+
+    def sync(self, name):
+        self.controller.factory.pump_until_quiet()
+        self.controller.sync_handler(f"default/{name}")
+        self.controller.factory.pump_until_quiet()
+
+    def schedule(self):
+        return self.scheduler.schedule_once()
+
+    def job(self, name):
+        return self.controller.tpujobs.tpujobs("default").get(name)
+
+    def worker_pods(self, name):
+        return sorted(
+            (
+                p
+                for p in self.api.list("pods", "default")
+                if p["metadata"]["name"].startswith(name + "-worker-")
+            ),
+            key=lambda p: p["metadata"]["name"],
+        )
+
+    def finish_workers(self, name):
+        for pod in self.worker_pods(name):
+            pod["status"]["phase"] = "Succeeded"
+            self.api.update_status("pods", pod)
+
+    def condition(self, name, cond_type):
+        return st.get_condition(self.job(name).status, cond_type)
+
+
+def make_pod(name, gang, chips=4, namespace="default", accel="v5e-16"):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "annotations": {GROUP_ANNOTATION: gang},
+        },
+        "spec": {
+            "schedulerName": DEFAULT_SCHEDULER_NAME,
+            "containers": [
+                {
+                    "resources": {"requests": {"google.com/tpu": chips}},
+                    "env": [{"name": "TPU_ACCELERATOR_TYPE", "value": accel}],
+                }
+            ],
+        },
+    }
+
+
+def make_group(api, name, min_member, priority_class="", namespace="default"):
+    spec = {"minMember": min_member}
+    if priority_class:
+        spec["priorityClassName"] = priority_class
+    api.create(
+        "podgroups",
+        {
+            "apiVersion": "scheduling.x-k8s.io/v1alpha1",
+            "kind": "PodGroup",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": spec,
+        },
+    )
+
+
+class TestInventory:
+    def test_parse_counts_and_topology_override(self):
+        parsed = parse_inventory("v5e-16:2,v4-32,v5e-8/2x4")
+        assert [(s.accelerator_type, s.topology, n) for s, n in parsed] == [
+            ("v5e-16", "4x4", 2),
+            ("v4-32", "2x4x4", 1),
+            ("v5e-8", "2x4", 1),
+        ]
+
+    @pytest.mark.parametrize("bad", ["", "v9-16", "v5e-16:0", "v5e-16:x", "v5e-16/3x5"])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(InventoryError):
+            parse_inventory(bad)
+
+    def test_build_nodes_shape(self):
+        nodes = build_nodes("v5e-16:2,v4-32")
+        # 2 slices x 4 hosts + 1 slice x 8 hosts.
+        assert len(nodes) == 16
+        by_name = {n["metadata"]["name"]: n for n in nodes}
+        n0 = by_name["tpu-v5e-16-s0-h0"]
+        assert n0["status"]["capacity"]["google.com/tpu"] == 4
+        labels = n0["metadata"]["labels"]
+        assert labels["tpu.operator.kubeflow.org/slice"] == "v5e-16-0"
+        assert labels["tpu.operator.kubeflow.org/generation"] == "v5e"
+        assert labels["tpu.operator.kubeflow.org/host-coord"] == "0-0"
+        # Distinct slices for the two v5e-16 entries.
+        slices = {
+            n["metadata"]["labels"]["tpu.operator.kubeflow.org/slice"]
+            for n in nodes
+        }
+        assert slices == {"v5e-16-0", "v5e-16-1", "v4-32-0"}
+
+    def test_register_nodes_idempotent(self):
+        api = InMemoryAPIServer()
+        assert len(register_nodes(api, "v5e-16")) == 4
+        assert len(register_nodes(api, "v5e-16")) == 4
+        assert len(api.list("nodes", None)) == 4
+
+
+class TestCacheAccounting:
+    def _cache(self):
+        cache = SchedulerCache()
+        for node in build_nodes("v5e-16:1"):
+            cache.add_node(NodeInfo.from_node_object(node))
+        return cache
+
+    def test_reserve_commit_release_invariant(self):
+        cache = self._cache()
+        key = ("default", "p0")
+        cache.reserve(key, "tpu-v5e-16-s0-h0", 4)
+        assert (cache.total_reserved(), cache.total_allocated()) == (4, 0)
+        cache.commit(key)
+        assert (cache.total_reserved(), cache.total_allocated()) == (0, 4)
+        cache.release(key)
+        assert cache.total_free() == cache.total_capacity() == 16
+
+    def test_reserve_over_capacity_raises(self):
+        cache = self._cache()
+        cache.reserve(("d", "a"), "tpu-v5e-16-s0-h0", 4)
+        with pytest.raises(RuntimeError):
+            cache.reserve(("d", "b"), "tpu-v5e-16-s0-h0", 1)
+
+    def test_node_loss_purges_ledger(self):
+        cache = self._cache()
+        cache.reserve(("d", "a"), "tpu-v5e-16-s0-h0", 4)
+        cache.remove_node("tpu-v5e-16-s0-h0")
+        assert cache.total_reserved() == 0
+        assert cache.total_capacity() == 12
+
+    def test_reconcile_rebuilds_from_live_pods(self):
+        cache = self._cache()
+        bound = make_pod("b0", "g")
+        bound["spec"]["nodeName"] = "tpu-v5e-16-s0-h0"
+        done = make_pod("b1", "g")
+        done["spec"]["nodeName"] = "tpu-v5e-16-s0-h1"
+        done["status"] = {"phase": "Succeeded"}
+        cache.reserve(("default", "gone"), "tpu-v5e-16-s0-h2", 4)
+        cache.reconcile([bound, done])
+        # Terminal pod and vanished reservation both freed.
+        assert cache.total_allocated() == 4
+        assert cache.total_reserved() == 0
+
+
+class TestGangContention:
+    def test_second_gang_waits_then_schedules(self):
+        """Two 4-host gangs, one slice: the second stays Pending with an
+        Unschedulable job condition until the first finishes."""
+        c = Cluster("v5e-16:1")
+        c.new_job("first")
+        c.sync("first")
+        assert c.schedule()["bound"] == 4
+        c.new_job("second")
+        c.sync("second")
+        out = c.schedule()
+        assert out == {"bound": 0, "pending_gangs": 1}
+
+        for pod in c.worker_pods("second"):
+            assert "nodeName" not in pod["spec"]
+            (cond,) = pod["status"]["conditions"]
+            assert cond["status"] == "False" and cond["reason"] == "Unschedulable"
+            assert cond["message"].startswith("0/4 nodes are available:")
+        c.sync("second")
+        cond = c.condition("second", "Scheduled")
+        assert cond.status == "False" and cond.reason == "Unschedulable"
+        assert ("Warning", "FailedScheduling") in [
+            (e.type, e.reason) for e in c.controller.recorder.events
+        ]
+
+        # First gang completes -> its chips free up -> second schedules.
+        c.finish_workers("first")
+        assert c.schedule()["bound"] == 4
+        c.sync("second")
+        assert all(p["spec"]["nodeName"] for p in c.worker_pods("second"))
+        cond = c.condition("second", "Scheduled")
+        assert cond.status == "True"
+        assert ("Normal", "Scheduled") in [
+            (e.type, e.reason) for e in c.controller.recorder.events
+        ]
+        # No chip leaked anywhere in the exchange.
+        cache = c.scheduler.cache
+        assert cache.total_reserved() == 0
+        assert cache.total_allocated() == 16
+
+    def test_scheduled_condition_set_on_success(self):
+        c = Cluster("v5e-16:1")
+        c.new_job("solo")
+        c.sync("solo")
+        c.schedule()
+        c.sync("solo")
+        cond = c.condition("solo", "Scheduled")
+        assert cond is not None and cond.status == "True"
+
+
+class TestPreemption:
+    def test_high_priority_gang_evicts_whole_lower_gang(self):
+        c = Cluster("v5e-16:1")
+        c.new_job("low", priority_class="low-priority")
+        c.sync("low")
+        assert c.schedule()["bound"] == 4
+        c.new_job("high", priority_class="high-priority")
+        c.sync("high")
+        assert c.schedule()["bound"] == 4
+
+        # Atomic: every low worker evicted, never a partial gang.
+        assert c.worker_pods("low") == []
+        assert all(p["spec"].get("nodeName") for p in c.worker_pods("high"))
+        preempted = [
+            e for e in c.scheduler.recorder.events if e.reason == "Preempted"
+        ]
+        assert sorted(e.involved_name for e in preempted) == [
+            f"low-worker-{i}" for i in range(4)
+        ]
+        # Chips re-accounted with zero leak.
+        cache = c.scheduler.cache
+        assert cache.total_reserved() == 0
+        assert cache.total_allocated() == 16
+        assert cache.total_free() == 0
+        assert c.scheduler.preemptions_total.value() == 1
+
+    def test_equal_priority_never_preempts(self):
+        c = Cluster("v5e-16:1")
+        c.new_job("a", priority_class="high-priority")
+        c.sync("a")
+        c.schedule()
+        c.new_job("b", priority_class="high-priority")
+        c.sync("b")
+        out = c.schedule()
+        assert out["bound"] == 0 and out["pending_gangs"] == 1
+        assert len(c.worker_pods("a")) == 4  # untouched
+
+
+class TestTopologyPacking:
+    def test_gang_packs_one_slice_contiguously(self):
+        api = InMemoryAPIServer()
+        register_nodes(api, "v5e-16:2")
+        make_group(api, "gang", 4)
+        for i in range(4):
+            api.create("pods", make_pod(f"w-{i}", "gang"))
+        s = GangScheduler(api)
+        assert s.schedule_once()["bound"] == 4
+        nodes = [api.get("pods", "default", f"w-{i}")["spec"]["nodeName"] for i in range(4)]
+        # One slice, all four hosts, in host order (contiguous block).
+        assert nodes == [f"tpu-v5e-16-s0-h{i}" for i in range(4)]
+
+    def test_small_gang_leaves_whole_slice_for_big_gang(self):
+        api = InMemoryAPIServer()
+        register_nodes(api, "v5e-16:2")
+        make_group(api, "small", 2)
+        for i in range(2):
+            api.create("pods", make_pod(f"s-{i}", "small"))
+        s = GangScheduler(api)
+        s.schedule_once()
+        make_group(api, "big", 4)
+        for i in range(4):
+            api.create("pods", make_pod(f"b-{i}", "big"))
+        assert s.schedule_once()["bound"] == 4
+        small_slices = {
+            api.get("pods", "default", f"s-{i}")["spec"]["nodeName"].rsplit("-h", 1)[0]
+            for i in range(2)
+        }
+        big_slices = {
+            api.get("pods", "default", f"b-{i}")["spec"]["nodeName"].rsplit("-h", 1)[0]
+            for i in range(4)
+        }
+        assert len(small_slices) == 1 and len(big_slices) == 1
+        assert small_slices != big_slices
+
+    def test_generation_mismatch_is_filtered(self):
+        api = InMemoryAPIServer()
+        register_nodes(api, "v4-16")  # 3D generation, wrong for a v5e pod
+        make_group(api, "gang", 1)
+        api.create("pods", make_pod("w-0", "gang", accel="v5e-4"))
+        s = GangScheduler(api)
+        assert s.schedule_once()["bound"] == 0
+        cond = api.get("pods", "default", "w-0")["status"]["conditions"][0]
+        assert "mismatched TPU generation" in cond["message"]
+
+
+class TestWaitlist:
+    def _incomplete_gang(self):
+        api = InMemoryAPIServer()
+        register_nodes(api, "v5e-16:1")
+        make_group(api, "gang", 4)
+        for i in range(2):  # only half the gang exists
+            api.create("pods", make_pod(f"w-{i}", "gang"))
+        time_ = [NOW]
+        s = GangScheduler(api, clock=lambda: time_[0], gang_wait_timeout=30.0)
+        return api, s, time_
+
+    def test_incomplete_gang_holds_reservations(self):
+        api, s, _ = self._incomplete_gang()
+        out = s.schedule_once()
+        assert out == {"bound": 0, "pending_gangs": 1}
+        # Capacity held for the arrived members, nothing bound.
+        assert s.cache.total_reserved() == 8
+        assert "nodeName" not in api.get("pods", "default", "w-0")["spec"]
+
+    def test_timeout_releases_hold_then_late_members_still_schedule(self):
+        api, s, time_ = self._incomplete_gang()
+        s.schedule_once()
+        time_[0] = NOW + 31
+        s.schedule_once()
+        assert s.cache.total_reserved() == 0
+        assert any(
+            e.reason == "FailedScheduling" and "releasing reserved capacity" in e.message
+            for e in s.recorder.events
+        )
+        # Missing members arrive late: the gang still goes through.
+        for i in range(2, 4):
+            api.create("pods", make_pod(f"w-{i}", "gang"))
+        assert s.schedule_once()["bound"] == 4
+        assert s.cache.total_reserved() == 0
+
+
+class TestSchedulerMetrics:
+    def test_latency_histogram_and_pending_gauge_exposed(self):
+        c = Cluster("v5e-16:1")
+        c.new_job("first")
+        c.sync("first")
+        c.schedule()
+        c.new_job("second")
+        c.sync("second")
+        c.schedule()
+        text = c.scheduler.registry.expose()
+        assert (
+            'tpu_operator_scheduler_scheduling_duration_seconds_count'
+            '{result="scheduled"} 1' in text
+        )
+        assert "tpu_operator_scheduler_pending_gangs 1" in text
+        assert "tpu_operator_scheduler_binds_total 4.0" in text
+
+    def test_latency_measures_wait_time(self):
+        api = InMemoryAPIServer()
+        register_nodes(api, "v5e-16:1")
+        time_ = [NOW]
+        s = GangScheduler(api, clock=lambda: time_[0])
+        make_group(api, "a", 4)
+        for i in range(4):
+            api.create("pods", make_pod(f"a-{i}", "a"))
+        s.schedule_once()
+        make_group(api, "b", 4)
+        for i in range(4):
+            api.create("pods", make_pod(f"b-{i}", "b"))
+        s.schedule_once()  # b first seen at NOW, blocked
+        time_[0] = NOW + 50
+        for i in range(4):
+            pod = api.get("pods", "default", f"a-{i}")
+            pod["status"]["phase"] = "Succeeded"
+            api.update_status("pods", pod)
+        s.schedule_once()  # b binds 50s after first sighting
+        assert s.scheduling_duration.sample_sum("scheduled") == pytest.approx(50.0)
+        assert s.scheduling_duration.sample_count("scheduled") == 2
+
+
+class TestCompatAutoBind:
+    def test_default_runner_mode_binds_on_creation(self):
+        """No scheduler: the runner's auto-bind keeps the pre-scheduler
+        contract — pods get a node the moment they are seen."""
+        from mpi_operator_tpu.runtime.podrunner import LocalPodRunner
+
+        api = InMemoryAPIServer()
+        runner = LocalPodRunner(api)
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "p", "namespace": "default"},
+            "spec": {"containers": [{"command": ["python", "-c", "pass"]}]},
+        }
+        api.create("pods", pod)
+        runner.start()
+        try:
+            import time as _time
+
+            deadline = _time.time() + 10
+            while _time.time() < deadline:
+                got = api.get("pods", "default", "p")
+                if (got.get("status") or {}).get("phase") == "Succeeded":
+                    break
+                _time.sleep(0.05)
+            got = api.get("pods", "default", "p")
+            assert got["spec"]["nodeName"] == "local-node"
+            assert got["status"]["phase"] == "Succeeded"
+        finally:
+            runner.stop()
+
+    def test_scheduler_mode_runner_waits_for_bind(self):
+        from mpi_operator_tpu.runtime.podrunner import LocalPodRunner
+
+        api = InMemoryAPIServer()
+        runner = LocalPodRunner(api, auto_bind=False)
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "p", "namespace": "default"},
+            "spec": {"containers": [{"command": ["python", "-c", "pass"]}]},
+        }
+        api.create("pods", pod)
+        runner.start()
+        try:
+            import time as _time
+
+            _time.sleep(0.3)
+            got = api.get("pods", "default", "p")
+            assert "nodeName" not in got["spec"]
+            assert not (got.get("status") or {}).get("phase")
+            # Bind it (what the gang scheduler's Binder does) -> it runs.
+            from mpi_operator_tpu.scheduler import Binder
+
+            Binder(api).bind("default", "p", "node-x")
+            deadline = _time.time() + 10
+            while _time.time() < deadline:
+                got = api.get("pods", "default", "p")
+                if (got.get("status") or {}).get("phase") == "Succeeded":
+                    break
+                _time.sleep(0.05)
+            assert got["status"]["phase"] == "Succeeded"
+            # The scheduler's condition survived the phase flips.
+            assert got["status"]["conditions"][0]["type"] == "PodScheduled"
+        finally:
+            runner.stop()
+
+
+class TestPluginInterface:
+    def test_capacity_plugin_filters_and_scores(self):
+        plugin = TPUCapacityPlugin()
+        ctx = SchedulingContext()
+        node = NodeInfo(name="n", capacity=4, generation="v5e")
+        pod = make_pod("p", "g")
+        assert plugin.filter(ctx, pod, node) is None
+        node.allocated = 4
+        assert plugin.filter(ctx, pod, node) == "Insufficient google.com/tpu"
+        assert plugin.score(ctx, pod, node) == 4  # most-allocated bias
+
+    def test_topology_plugin_prefers_chosen_slice(self):
+        plugin = TopologyPackPlugin()
+        ctx = SchedulingContext(
+            gang_name="g",
+            remaining_chips=4,
+            chosen_slice="s0",
+            slice_free={"s0": 8, "s1": 16},
+        )
+        pod = make_pod("p", "g")
+        in_slice = NodeInfo(name="a", capacity=4, slice_name="s0")
+        other = NodeInfo(name="b", capacity=4, slice_name="s1")
+        assert plugin.score(ctx, pod, in_slice) > plugin.score(ctx, pod, other)

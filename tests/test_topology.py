@@ -82,3 +82,55 @@ class TestHostTiling:
         # 2x2x1 blocks can tile 2x2x3 (two even dims suffice).
         shape = resolve("v4-12", "2x2x3")
         assert shape.num_hosts == 3
+
+    def test_indivisible_chip_count_rejected(self):
+        # 20 chips pass the even-dims check as 2x10 but 2x2-tile fine;
+        # 2x9 = 18 chips is the true indivisible case.
+        with pytest.raises(TopologyError, match="divisible"):
+            resolve("v5e-18", "2x9")
+
+
+class TestHostGrid:
+    def test_single_host_2d_small_slices(self):
+        # <=8-chip 2D slices are one host machine owning the whole grid.
+        for atype, topo in (("v5e-4", "2x2"), ("v5e-8", "2x4"), ("v5e-1", "1x1")):
+            shape = resolve(atype, topo)
+            assert topology.host_grid(shape) == [(0, 0)]
+
+    def test_2d_grid_row_major(self):
+        grid = topology.host_grid(resolve("v5e-16", "4x4"))
+        assert grid == [(0, 0), (0, 2), (2, 0), (2, 2)]
+
+    def test_3d_block_math(self):
+        # Canonical 2x2x1 blocks walk the innermost dim fastest.
+        grid = topology.host_grid(resolve("v4-32", "2x4x4"))
+        assert len(grid) == 8
+        assert grid[0] == (0, 0, 0)
+        assert grid[1] == (0, 0, 1)  # adjacent along z (block depth 1)
+        assert grid[-1] == (0, 2, 3)
+
+    def test_3d_block_orientation_follows_even_dims(self):
+        # 2x3x2: the host block must be 2x1x2 (dims 0 and 2 are the even
+        # ones), so hosts advance along the middle dimension.
+        assert topology.host_block_dims((2, 3, 2)) == (2, 1, 2)
+        grid = topology.host_grid(resolve("v4-12", "2x3x2"))
+        assert grid == [(0, 0, 0), (0, 1, 0), (0, 2, 0)]
+
+    def test_grid_covers_slice_exactly(self):
+        # Every chip belongs to exactly one host block.
+        shape = resolve("v4-64", "4x4x4")
+        block = topology.host_block_dims(shape.dims())
+        seen = set()
+        for origin in topology.host_grid(shape):
+            for dx in range(block[0]):
+                for dy in range(block[1]):
+                    for dz in range(block[2]):
+                        chip = (origin[0] + dx, origin[1] + dy, origin[2] + dz)
+                        assert chip not in seen
+                        seen.add(chip)
+        assert len(seen) == shape.chips
+
+    def test_resolve_shape_or_none(self):
+        assert topology.resolve_shape_or_none("v5e-16").num_hosts == 4
+        assert topology.resolve_shape_or_none("v99-16") is None
+        assert topology.resolve_shape_or_none("v5e-16", "1x16") is None
