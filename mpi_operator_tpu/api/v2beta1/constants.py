@@ -48,6 +48,12 @@ ENV_JOB_NAMESPACE = "TPUJOB_NAMESPACE"
 ENV_NUM_SLICES = "TPUJOB_NUM_SLICES"
 ENV_SLICE_ID = "TPUJOB_SLICE_ID"
 
+# Cross-process trace propagation (W3C traceparent analog): the controller
+# stamps the reconcile's (trace id, span id) into every pod it builds, and
+# launcher/train adopt it on startup, so operator, launcher, and worker
+# spans share one trace id end to end (utils/trace.TraceContext).
+ENV_TRACE_CONTEXT = "TPU_TRACE_CONTEXT"
+
 # Multislice (DCN) rendezvous: when numSlices > 1, libtpu's megascale
 # runtime forms the cross-slice transport from these variables — the same
 # contract GKE's JobSet TPU integration sets for its pods. Slice 0's host

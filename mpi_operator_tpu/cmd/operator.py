@@ -37,7 +37,8 @@ from ..controller.tpu_job_controller import TPUJobController
 from ..runtime.apiserver import InMemoryAPIServer, NotFoundError
 from ..runtime.leaderelection import LeaderElectionConfig, LeaderElector
 from ..runtime.podrunner import LocalPodRunner
-from ..utils import metrics, trace
+from ..utils import flightrecorder, metrics, trace
+from ..utils import logging as logutil
 from ..version import version_string
 
 
@@ -72,6 +73,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "The default fits the shipped examples; a gang "
                         "whose acceleratorType matches no slice stays "
                         "Unschedulable until the inventory does")
+    p.add_argument("--log-level", default="info",
+                   choices=["debug", "info", "warning", "error"],
+                   help="structured-log severity threshold")
+    p.add_argument("--log-format", default="text",
+                   choices=[logutil.FORMAT_TEXT, logutil.FORMAT_JSON],
+                   help="structured-log output format: text = klog-style "
+                        "lines, json = one JSON object per line")
     p.add_argument("--leader-elect", action="store_true",
                    help="enable leader election for HA deployments")
     p.add_argument("--lock-namespace", default="default",
@@ -100,13 +108,35 @@ def build_parser() -> argparse.ArgumentParser:
 class _MonitoringHandler(BaseHTTPRequestHandler):
     registry: metrics.Registry = None
     tracer: trace.Tracer = None
+    flight_recorder: Optional[flightrecorder.FlightRecorder] = None
     health_fn = staticmethod(lambda: True)
+
+    def _timeline_body(self) -> Optional[bytes]:
+        """Body for /debug/jobs/<ns>/<name>/timeline, or None for 404
+        (no recorder wired, or a job the recorder has never seen)."""
+        parts = self.path.split("/")
+        # ['', 'debug', 'jobs', ns, name, 'timeline']
+        if len(parts) != 6 or parts[5] != "timeline":
+            return None
+        if self.flight_recorder is None:
+            return None
+        timeline = self.flight_recorder.to_json(parts[3], parts[4])
+        return None if timeline is None else timeline.encode()
 
     def do_GET(self):  # noqa: N802
         if self.path == "/metrics":
             body = self.registry.expose().encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
+        elif self.path.startswith("/debug/jobs/"):
+            body = self._timeline_body()
+            if body is None:
+                body = b"not found"
+                self.send_response(404)
+                self.send_header("Content-Type", "text/plain")
+            else:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
         elif self.path == "/healthz":
             ok = self.health_fn()
             body = b"ok" if ok else b"unhealthy"
@@ -134,9 +164,12 @@ class _MonitoringHandler(BaseHTTPRequestHandler):
 
 def start_monitoring(port: int, registry: metrics.Registry, health_fn,
                      address: str = "127.0.0.1",
-                     tracer: Optional[trace.Tracer] = None):
+                     tracer: Optional[trace.Tracer] = None,
+                     flight_recorder: Optional[
+                         flightrecorder.FlightRecorder] = None):
     """startMonitoring (main.go:29-40) + healthz server (:192-208) analog,
-    plus the ``/debug/trace`` span dump."""
+    plus the ``/debug/trace`` span dump and per-job
+    ``/debug/jobs/<ns>/<name>/timeline`` flight-recorder endpoint."""
     handler = type(
         "Handler",
         (_MonitoringHandler,),
@@ -144,6 +177,7 @@ def start_monitoring(port: int, registry: metrics.Registry, health_fn,
             "registry": registry,
             # "is None", not "or": an empty Tracer is falsy (__len__).
             "tracer": trace.DEFAULT_TRACER if tracer is None else tracer,
+            "flight_recorder": flight_recorder,
             "health_fn": staticmethod(health_fn),
         },
     )
@@ -202,6 +236,9 @@ def _ua() -> str:
 
 def run(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    logutil.configure(
+        level=logutil.parse_level(args.log_level), format=args.log_format
+    )
     if args.enable_scheduler and args.backend != "memory":
         print(
             "--enable-scheduler requires --backend memory (a real cluster "
@@ -213,6 +250,11 @@ def run(argv=None) -> int:
     api, runner = build_backend(args)
     check_crd_exists(api, args.namespace)
     registry = metrics.Registry()
+    # One flight recorder shared by every component that can contribute a
+    # timeline entry: controller, scheduler, pod runner, monitoring.
+    recorder = flightrecorder.FlightRecorder()
+    if runner is not None:
+        runner.flight_recorder = recorder
     is_leader = metrics.new_gauge(
         "tpu_operator_is_leader", "1 if this replica is the leader", (), registry
     )
@@ -243,7 +285,7 @@ def run(argv=None) -> int:
             f"scheduler: registered {len(nodes)} TPU host node(s) from "
             f"inventory {args.node_inventory!r}"
         )
-        scheduler = GangScheduler(api, registry=registry)
+        scheduler = GangScheduler(api, registry=registry, flight_recorder=recorder)
         # Workers must carry the gang annotation + schedulerName for
         # all-or-nothing admission; default it when the user didn't pick
         # an external gang scheduler explicitly.
@@ -254,6 +296,7 @@ def run(argv=None) -> int:
         namespace=args.namespace,
         gang_scheduler_name=args.gang_scheduling,
         registry=registry,
+        flight_recorder=recorder,
     )
     # Controller metrics share the exposed registry.
     if runner is not None:
@@ -336,7 +379,8 @@ def run(argv=None) -> int:
     if args.monitoring_port:
         health = elector.healthy if elector is not None else (lambda: True)
         start_monitoring(
-            args.monitoring_port, registry, health, address=args.monitoring_address
+            args.monitoring_port, registry, health,
+            address=args.monitoring_address, flight_recorder=recorder,
         )
         print(
             f"monitoring on http://{args.monitoring_address}:"

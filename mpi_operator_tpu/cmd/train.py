@@ -27,12 +27,14 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
-import logging
 import sys
 import time
 from typing import Callable, Optional
 
-log = logging.getLogger("tpujob.train")
+from ..utils import trace
+from ..utils.logging import get_logger
+
+log = get_logger("train")
 
 
 def parse_mesh_spec(spec: str) -> dict[str, int]:
@@ -794,9 +796,9 @@ def build_workload(args, mesh, n_devices: int) -> Workload:
 
 
 def main(argv=None) -> int:
-    logging.basicConfig(
-        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
-    )
+    # Join the operator's trace before anything logs: bootstrap.initialize
+    # adopts too, but rendezvous can log (and fail) first.
+    trace.adopt_from_environ()
     args = build_parser().parse_args(argv)
     if args.steps < 1:
         raise SystemExit("--steps must be >= 1")

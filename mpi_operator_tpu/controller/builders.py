@@ -195,6 +195,14 @@ def _worker_env(job: TPUJob, index: int, shape: topology.SliceShape) -> list[dic
         {"name": constants.ENV_JOB_NAME, "value": job.name},
         {"name": constants.ENV_JOB_NAMESPACE, "value": job.namespace},
     ]
+    ctx = trace.current_context()
+    if ctx is not None:
+        # Trace propagation: the launcher/worker process adopts this on
+        # startup, parenting its spans under the builder span that
+        # stamped it (one trace id from reconcile to jax.distributed).
+        env.append(
+            {"name": constants.ENV_TRACE_CONTEXT, "value": ctx.encode()}
+        )
     if num_slices > 1:
         env += [
             {"name": constants.ENV_NUM_SLICES, "value": str(num_slices)},
@@ -303,6 +311,12 @@ def new_launcher_job(job: TPUJob, gang_scheduler_name: str = "") -> KubeObject:
         {"name": constants.ENV_JOB_NAME, "value": job.name},
         {"name": constants.ENV_JOB_NAMESPACE, "value": job.namespace},
     ]
+    ctx = trace.current_context()
+    if ctx is not None:
+        # Same propagation contract as worker pods (_worker_env).
+        container["env"] = container["env"] + [
+            {"name": constants.ENV_TRACE_CONTEXT, "value": ctx.encode()}
+        ]
     pod_spec["containers"] = containers
 
     if gang_scheduler_name:

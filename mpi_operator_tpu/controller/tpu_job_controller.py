@@ -20,7 +20,6 @@ from the reference, all TPU-motivated:
 
 from __future__ import annotations
 
-import logging
 import threading
 import time
 from typing import Callable, Optional
@@ -54,7 +53,8 @@ from ..runtime.client import KubeClient, SchedulingClient, TPUJobClient
 from ..runtime.informer import EventHandler, InformerFactory, meta_namespace_key, split_key
 from ..runtime.objects import KubeObject
 from ..runtime.workqueue import RateLimitingQueue
-from ..utils import metrics, trace
+from ..utils import flightrecorder, metrics, statemetrics, trace
+from ..utils import logging as logutil
 from ..utils.events import (
     EVENT_TYPE_NORMAL,
     EVENT_TYPE_WARNING,
@@ -120,6 +120,7 @@ class TPUJobController:
         recorder: Optional[EventRecorder] = None,
         registry: Optional[metrics.Registry] = None,
         tracer: Optional[trace.Tracer] = None,
+        flight_recorder: Optional[flightrecorder.FlightRecorder] = None,
         clock: Callable[[], float] = time.time,
     ):
         self.api = api
@@ -129,11 +130,22 @@ class TPUJobController:
         self.gang_scheduler_name = gang_scheduler_name
         self.clock = clock
         self.recorder = recorder or EventRecorder(api, clock=clock)
+        self.log = logutil.get_logger("controller")
 
         registry = registry or metrics.Registry()
         self.registry = registry
         # "is None", not "or": an empty Tracer is falsy (it has __len__).
         self.tracer = trace.DEFAULT_TRACER if tracer is None else tracer
+        # Flight recorder: always on (bounded), fed below by condition
+        # transitions and the event recorder; the scheduler and podrunner
+        # share the same instance when the operator wires one through.
+        # "is None", not "or": an empty FlightRecorder is falsy (__len__).
+        self.flight_recorder = (
+            flightrecorder.FlightRecorder(clock=clock)
+            if flight_recorder is None
+            else flight_recorder
+        )
+        self.recorder.subscribe(self.flight_recorder.observe_event)
         self.jobs_created = metrics.new_counter(
             "tpu_operator_jobs_created_total", "Counts number of TPU jobs created",
             registry=registry,
@@ -145,12 +157,6 @@ class TPUJobController:
         self.jobs_failed = metrics.new_counter(
             "tpu_operator_jobs_failed_total", "Counts number of TPU jobs failed",
             registry=registry,
-        )
-        self.job_info = metrics.new_gauge(
-            "tpu_operator_job_info",
-            "Information about TPUJob",
-            ("launcher", "namespace"),
-            registry,
         )
         # Reconcile observability: where sync time goes, what fails, and
         # when each job condition last flipped.
@@ -182,6 +188,12 @@ class TPUJobController:
         self.configmap_informer = self.factory.informer("configmaps")
         self.job_informer = self.factory.informer("jobs")
         self.podgroup_informer = self.factory.informer("podgroups")
+
+        # kube-state-style gauges (job_info, jobs/pods by_phase, job
+        # conditions) recomputed from the informer caches at scrape time.
+        self.state_metrics = statemetrics.StateMetrics(
+            registry, self.tpujob_informer.lister, self.pod_informer.lister
+        )
 
         self.queue = RateLimitingQueue(name="TPUJobs", registry=registry)
 
@@ -312,7 +324,9 @@ class TPUJobController:
             self.sync_handler(key)
         except Exception as e:  # transient: requeue with backoff (:430)
             self.queue.add_rate_limited(key)
-            logging.getLogger(__name__).warning("error syncing %r: %s", key, e)
+            self.log.warning(
+                "error syncing %r: %s", key, e, error=type(e).__name__
+            )
         else:
             self.queue.forget(key)
         finally:
@@ -362,6 +376,19 @@ class TPUJobController:
                 cond.last_transition_time if cond is not None else now,
                 job.namespace, job.name, type_,
             )
+            self.flight_recorder.record(
+                job.namespace,
+                job.name,
+                flightrecorder.CONDITION,
+                reason=reason,
+                message=message,
+                type=type_,
+                status=status,
+            )
+            self.log.info(
+                "condition %s=%s (%s)", type_, status, reason,
+                namespace=job.namespace, tpujob=job.name,
+            )
 
     def sync_handler(self, key: str) -> None:
         """Instrumented entrypoint: every sync pass — worker loop or
@@ -375,6 +402,11 @@ class TPUJobController:
                 self.sync_duration.observe(time.perf_counter() - t0, "error")
                 self.sync_errors.inc(1, type(e).__name__)
                 raise
+            # Inside the span so the record carries its trace id.
+            self.log.debug(
+                "synced %s", key,
+                duration_ms=round((time.perf_counter() - t0) * 1000.0, 3),
+            )
         self.sync_duration.observe(time.perf_counter() - t0, "success")
 
     def _sync_job(self, key: str) -> None:
@@ -382,9 +414,10 @@ class TPUJobController:
         namespace, name = split_key(key)
         shared = self.tpujob_informer.lister.get(namespace, name)
         if shared is None:
-            # Deleted; dependents go via GC. Drop its info series and any
-            # condition-transition timestamps.
-            self.job_info.remove(name + constants.LAUNCHER_SUFFIX, namespace)
+            # Deleted; dependents go via GC. Drop its condition-transition
+            # timestamps (state metrics recompute from the cache, so their
+            # series vanish on the next scrape without bookkeeping; the
+            # flight recorder keeps its timeline for post-mortems).
             self.condition_transitions.remove_matching(namespace, name)
             return
         job = TPUJob.from_dict(shared)  # never mutate the cache (:475-478)
@@ -984,7 +1017,6 @@ class TPUJobController:
                     self._update_job_failed_status(job, launcher, launcher_pods, now)
             else:
                 lstatus.active = running_launchers
-            self.job_info.labels(launcher["metadata"]["name"], job.namespace).set(1)
 
         running = evicted = succeeded = 0
         failed_pods: list[str] = []
@@ -1204,9 +1236,9 @@ class TPUJobController:
         except ConflictError:
             live = client.get(job.name)
             if st.is_finished(live.status) and not st.is_finished(job.status):
-                logging.getLogger(__name__).info(
-                    "dropping stale status write for %s/%s: live status "
-                    "is already terminal", job.namespace, job.name,
+                self.log.info(
+                    "dropping stale status write: live status is already "
+                    "terminal", namespace=job.namespace, tpujob=job.name,
                 )
                 return
             live.status = job.status

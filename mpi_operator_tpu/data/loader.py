@@ -22,7 +22,6 @@ jax-native answer to tf.data's ``prefetch(AUTOTUNE)``.
 from __future__ import annotations
 
 import ctypes
-import logging
 import os
 import pathlib
 import queue
@@ -31,9 +30,10 @@ from typing import Callable, Iterator, Optional
 
 import numpy as np
 
+from ..utils.logging import get_logger
 from .permutation import Feistel
 
-log = logging.getLogger(__name__)
+log = get_logger("data.loader")
 
 ENV_NATIVE_LIB = "TPUJOB_TOKENLOADER_LIB"
 _REPO_NATIVE = pathlib.Path(__file__).resolve().parents[2] / "native"

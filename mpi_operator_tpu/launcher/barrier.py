@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import ctypes
 import ctypes.util
-import logging
 import os
 import pathlib
 import socket
@@ -32,8 +31,9 @@ import time
 from typing import Optional
 
 from ..utils import trace
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger("launcher.barrier")
 
 MAGIC = b"TPUB"
 GO = b"GO!!"

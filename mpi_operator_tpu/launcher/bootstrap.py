@@ -10,15 +10,15 @@ forms the world.
 
 from __future__ import annotations
 
-import logging
 import os
 from dataclasses import dataclass
 from typing import Mapping, Optional
 
 from ..api.v2beta1 import constants
 from ..utils import trace
+from ..utils.logging import get_logger
 
-log = logging.getLogger(__name__)
+log = get_logger("launcher")
 
 
 @dataclass
@@ -168,6 +168,10 @@ def initialize(
     coordinator process exists — the SSH-retry analog (launcher.barrier).
     """
     global _initialized
+    # Adopt the controller-stamped trace context before any span opens:
+    # every span this process produces then shares the reconcile's trace
+    # id (operator -> launcher -> worker in one /debug/trace timeline).
+    trace.adopt_from_environ()
     cfg = config or RendezvousConfig.from_env()
     if not cfg.is_distributed:
         log.info("single-process TPUJob; skipping jax.distributed.initialize")

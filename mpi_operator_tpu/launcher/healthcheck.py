@@ -11,9 +11,9 @@ Run as ``python -m mpi_operator_tpu.launcher.healthcheck``.
 
 from __future__ import annotations
 
-import json
 import sys
 
+from ..utils.logging import emit_json
 from .bootstrap import RendezvousConfig, initialize
 
 
@@ -49,7 +49,9 @@ def run_healthcheck(config: RendezvousConfig | None = None) -> dict:
 
 def main() -> int:
     result = run_healthcheck()
-    print(json.dumps(result))
+    # Machine-readable result on stdout (one JSON line, sorted keys) via
+    # the shared structured-log writer, so consumers keep a stable shape.
+    emit_json(result, stream=sys.stdout)
     return 0 if result["ok"] else 1
 
 

@@ -30,6 +30,8 @@ import uuid
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from ..utils.logging import DEBUG, get_logger
+
 
 class ApiError(Exception):
     code = 0
@@ -165,6 +167,7 @@ class InMemoryAPIServer:
     def __init__(self, clock: Callable[[], float] = time.time):
         self._lock = threading.RLock()
         self._clock = clock
+        self._log = get_logger("apiserver")
         self._rv = itertools.count(1)
         # resource plural -> {(namespace, name) -> object dict}
         self._store: dict[str, dict[tuple[str, str], dict]] = {
@@ -197,6 +200,13 @@ class InMemoryAPIServer:
     def _record(self, verb: str, resource: str, obj: dict) -> None:
         ns, name = self._key(obj)
         self.actions.append((verb, resource, f"{ns}/{name}"))
+        # Request log (kube-apiserver audit-log analog): every write verb
+        # at debug, so `--log-level debug` shows the full mutation stream.
+        if self._log.enabled_for(DEBUG):
+            self._log.debug(
+                "%s %s %s/%s", verb, resource, ns, name,
+                rv=(obj.get("metadata") or {}).get("resourceVersion", ""),
+            )
 
     def clear_actions(self) -> None:
         self.actions.clear()

@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import base64
 import json
-import logging
 import os
 import random
 import ssl
@@ -57,8 +56,9 @@ from .apiserver import (
     NotFoundError,
     WatchEvent,
 )
+from ..utils.logging import get_logger
 
-log = logging.getLogger("tpujob.kube")
+log = get_logger("kube")
 
 BOOKMARK = "BOOKMARK"
 ERROR = "ERROR"

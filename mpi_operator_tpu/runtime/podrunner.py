@@ -30,6 +30,9 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from ..api.v2beta1 import constants
+from ..utils import flightrecorder
+from ..utils.logging import get_logger
 from .apiserver import (
     ADDED,
     DELETED,
@@ -62,8 +65,13 @@ class LocalPodRunner:
         workdir: Optional[str] = None,
         auto_bind: bool = True,
         node_name: str = DEFAULT_NODE_NAME,
+        flight_recorder: Optional[flightrecorder.FlightRecorder] = None,
     ):
         self.api = api
+        self.log = get_logger("podrunner")
+        # Shared with the controller when the operator wires one through:
+        # pod phase flips land on the owning job's timeline.
+        self.flight_recorder = flight_recorder
         self.base_env = base_env or {}
         self.workdir = workdir or os.getcwd()
         # A kubelet only runs pods bound to its node.  With no scheduler in
@@ -225,6 +233,7 @@ class LocalPodRunner:
                 text=True,
             )
             self._pods[key] = RunningPod(process=process)
+        self.log.info("started pod %s/%s", key[0], key[1], pid=process.pid)
         self._set_phase(key, "Running")
 
     def _kill(self, key: tuple[str, str]) -> None:
@@ -263,6 +272,10 @@ class LocalPodRunner:
                     self._pods.pop(key, None)
             elif restart_policy == "OnFailure" and running.restarts < MAX_RESTARTS:
                 running.restarts += 1
+                self.log.warning(
+                    "pod %s/%s exited rc=%d; restarting (%d/%d)",
+                    key[0], key[1], rc, running.restarts, MAX_RESTARTS,
+                )
                 process = subprocess.Popen(
                     self._command(pod),
                     env=self._child_env(pod),
@@ -304,8 +317,36 @@ class LocalPodRunner:
             self.api.update_status("pods", pod)
         except Exception:
             pass
+        if reason:
+            self.log.debug("pod %s/%s -> %s", key[0], key[1], phase,
+                           reason=reason)
+        else:
+            self.log.debug("pod %s/%s -> %s", key[0], key[1], phase)
+        self._record_pod_flip(pod, phase, reason, message)
         if phase == "Succeeded":
             self._mirror_job_success(pod)
+
+    def _record_pod_flip(
+        self, pod: dict, phase: str, reason: str, message: str
+    ) -> None:
+        """Put the phase flip on the owning TPUJob's flight-recorder
+        timeline.  Worker pods carry the job-name label directly; launcher
+        pods are owned by a batch Job whose template carries it too."""
+        if self.flight_recorder is None:
+            return
+        labels = pod["metadata"].get("labels") or {}
+        job_name = labels.get(constants.JOB_NAME_LABEL)
+        if not job_name:
+            return
+        self.flight_recorder.record(
+            pod["metadata"].get("namespace", ""),
+            job_name,
+            flightrecorder.POD,
+            reason=reason or phase,
+            message=message[-256:] if message else "",
+            pod=pod["metadata"]["name"],
+            phase=phase,
+        )
 
     def pod_log(self, namespace: str, name: str) -> str:
         with self._lock:

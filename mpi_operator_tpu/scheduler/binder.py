@@ -24,6 +24,7 @@ import time
 from typing import Callable, Optional
 
 from ..runtime.apiserver import ConflictError, NotFoundError
+from ..utils.logging import get_logger
 
 
 class BindError(RuntimeError):
@@ -52,6 +53,7 @@ class Binder:
     def __init__(self, api, clock=time.time):
         self._api = api
         self._clock = clock
+        self._log = get_logger("scheduler.binder")
 
     def bind(self, namespace: str, name: str, node_name: str) -> dict:
         for attempt in (1, 2):
@@ -76,10 +78,15 @@ class Binder:
                 continue
             pod["spec"]["nodeName"] = node_name
             try:
-                return self._api.update("pods", pod)
+                bound = self._api.update("pods", pod)
             except ConflictError:
                 if attempt == 2:
                     raise BindError(f"spec conflict binding {namespace}/{name}")
+                continue
+            self._log.debug(
+                "bound pod %s/%s to %s", namespace, name, node_name
+            )
+            return bound
         raise BindError(f"could not bind {namespace}/{name}")  # pragma: no cover
 
     def mark_unschedulable(self, namespace: str, name: str, message: str) -> None:
@@ -104,7 +111,8 @@ class Binder:
         try:
             self._api.update_status("pods", pod)
         except ConflictError:
-            pass
+            return
+        self._log.debug("marked pod %s/%s unschedulable", namespace, name)
 
 
 class FlakyBinder:

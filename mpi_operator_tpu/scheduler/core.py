@@ -30,8 +30,10 @@ import time
 from collections import Counter as TallyCounter
 from typing import Optional
 
+from ..api.v2beta1 import constants
 from ..utils import events as ev
-from ..utils import metrics
+from ..utils import flightrecorder, metrics
+from ..utils.logging import get_logger
 from .binder import Binder, BindError
 from .cache import NodeInfo, PodKey, SchedulerCache, pod_chips
 from .plugins import (
@@ -83,8 +85,13 @@ class GangScheduler:
         clock=time.time,
         interval: float = 0.2,
         registry: Optional[metrics.Registry] = None,
+        flight_recorder: Optional[flightrecorder.FlightRecorder] = None,
     ):
         self.api = api
+        self.log = get_logger("scheduler")
+        # Shared with the controller when the operator wires one through:
+        # scheduling decisions land on the owning job's timeline.
+        self.flight_recorder = flight_recorder
         registry = registry or metrics.Registry()
         self.registry = registry
         self.scheduling_duration = metrics.new_histogram(
@@ -150,8 +157,10 @@ class GangScheduler:
         while not self._stop.is_set():
             try:
                 self.schedule_once()
-            except Exception:  # the loop must survive transient API races
-                pass
+            except Exception as exc:  # the loop must survive transient API races
+                self.log.warning(
+                    "scheduling pass failed: %s", exc, error=type(exc).__name__
+                )
             self._stop.wait(self._interval)
 
     # -- one pass ---------------------------------------------------------
@@ -280,6 +289,32 @@ class GangScheduler:
         cls = (group.get("spec") or {}).get("priorityClassName", "")
         return self.priorities.get(cls, 0)
 
+    def _record_scheduling(
+        self, pods: list[dict], reason: str, message: str = "", **attrs
+    ) -> None:
+        """Flight-recorder hook: one SCHEDULING entry per owning TPUJob
+        (gang members all carry the same job-name label)."""
+        if self.flight_recorder is None:
+            return
+        seen: set[tuple[str, str]] = set()
+        for pod in pods:
+            meta = pod.get("metadata") or {}
+            job = (meta.get("labels") or {}).get(constants.JOB_NAME_LABEL)
+            if not job:
+                continue
+            key = (meta.get("namespace", ""), job)
+            if key in seen:
+                continue
+            seen.add(key)
+            self.flight_recorder.record(
+                key[0],
+                key[1],
+                flightrecorder.SCHEDULING,
+                reason=reason,
+                message=message,
+                **attrs,
+            )
+
     # -- placement --------------------------------------------------------
 
     def _assign(
@@ -391,6 +426,16 @@ class GangScheduler:
                 except NotFoundError:
                     pass
             self.preemptions_total.inc()
+            self.log.warning(
+                "preempted gang %s/%s for %s/%s", vkey[0], vkey[1],
+                gang_key[0], gang_key[1],
+            )
+            self._record_scheduling(
+                vpods,
+                ev.PREEMPTED_REASON,
+                f"preempted by {gang_key[0]}/{gang_key[1]}",
+                by=f"{gang_key[0]}/{gang_key[1]}",
+            )
         return assignments
 
     # -- outcomes ---------------------------------------------------------
@@ -439,6 +484,17 @@ class GangScheduler:
         self._wait_expired.discard(gang_key)
         self._last_failure_msg.pop(gang_key, None)
         self.scheduling_duration.observe(max(0.0, now - first_seen), "scheduled")
+        nodes = sorted(set(assignments.values()))
+        self.log.info(
+            "bound gang %s/%s (%d pods)", gang_key[0], gang_key[1],
+            len(assignments), nodes=",".join(nodes),
+        )
+        self._record_scheduling(
+            pods,
+            ev.SCHEDULED_REASON,
+            f"gang {gang_key[1]} bound to {', '.join(nodes)}",
+            pod_count=len(assignments),
+        )
         return True
 
     def _handle_incomplete(
@@ -487,3 +543,8 @@ class GangScheduler:
                 self.recorder.event(
                     pod, ev.EVENT_TYPE_WARNING, ev.FAILED_SCHEDULING_REASON, message
                 )
+        if first_report:
+            self.log.warning(
+                "gang %s/%s unschedulable: %s", gang_key[0], gang_key[1], message
+            )
+            self._record_scheduling(pods, ev.FAILED_SCHEDULING_REASON, message)

@@ -17,10 +17,11 @@ new mesh — see ``restore_latest(..., like=state)``.
 
 from __future__ import annotations
 
-import logging
 from typing import Any, Optional
 
-log = logging.getLogger(__name__)
+from .logging import get_logger
+
+log = get_logger("checkpoint")
 
 
 def _shapes_by_path(meta_tree: Any) -> dict[tuple, tuple]:

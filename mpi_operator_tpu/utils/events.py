@@ -10,11 +10,21 @@ from __future__ import annotations
 
 import itertools
 import time
+from collections import deque
 from dataclasses import dataclass
-from typing import Any
+from typing import Any, Callable
 
 EVENT_TYPE_NORMAL = "Normal"
 EVENT_TYPE_WARNING = "Warning"
+
+# Buffer/aggregation bounds (client-go event correlator analogs): the
+# in-process buffer is a ring so a long-running operator cannot grow
+# memory without limit, and identical events inside the similarity
+# window collapse into one Event with an incremented ``count`` (kube's
+# EventSeries/aggregation behavior; its aggregator also uses a
+# 10-minute window).
+DEFAULT_EVENT_BUFFER = 1000
+DEFAULT_AGGREGATION_WINDOW = 600.0
 
 # Scheduler event reasons (kube-scheduler vocabulary).
 SCHEDULED_REASON = "Scheduled"
@@ -57,6 +67,10 @@ class Event:
     involved_namespace: str
     timestamp: float
     source: str
+    # Event-series fields: ``timestamp`` stays the first occurrence;
+    # aggregated repeats bump ``count`` and ``last_timestamp``.
+    count: int = 1
+    last_timestamp: float = 0.0
 
     def to_object(self, name: str) -> dict:
         return {
@@ -73,6 +87,8 @@ class Event:
             },
             "source": {"component": self.source},
             "eventTime": self.timestamp,
+            "count": self.count,
+            "lastTimestamp": self.last_timestamp or self.timestamp,
         }
 
 
@@ -83,12 +99,36 @@ class EventRecorder:
     (fixture mode, like the fake record.FakeRecorder).
     """
 
-    def __init__(self, api=None, source: str = "tpu-job-controller", clock=time.time):
+    def __init__(
+        self,
+        api=None,
+        source: str = "tpu-job-controller",
+        clock=time.time,
+        capacity: int = DEFAULT_EVENT_BUFFER,
+        aggregation_window: float = DEFAULT_AGGREGATION_WINDOW,
+    ):
         self._api = api
         self.source = source
         self._clock = clock
         self._seq = itertools.count(1)
-        self.events: list[Event] = []
+        self.events: deque[Event] = deque(maxlen=capacity)
+        self._window = aggregation_window
+        # (involvedObject, type, reason, message) -> (Event, apiserver
+        # object name) for the live aggregation window.
+        self._recent: dict[tuple, tuple[Event, str]] = {}
+        self._subscribers: list[Callable[[Event], None]] = []
+
+    def subscribe(self, fn: Callable[[Event], None]) -> None:
+        """Register an observer called once per recorded occurrence (new
+        Events AND aggregated repeats, with the up-to-date Event)."""
+        self._subscribers.append(fn)
+
+    def _notify(self, ev: Event) -> None:
+        for fn in self._subscribers:
+            try:
+                fn(ev)
+            except Exception:  # observers must never break reconciliation
+                pass
 
     def event(self, obj: Any, type_: str, reason: str, message: str) -> None:
         meta = obj.metadata if hasattr(obj, "metadata") else None
@@ -99,23 +139,53 @@ class EventRecorder:
             kind = obj.get("kind", "")
             m = obj.get("metadata") or {}
             name, namespace = m.get("name", ""), m.get("namespace", "")
+        message = truncate_message(message)
+        now = self._clock()
+        key = (kind, namespace, name, type_, reason, message)
+
+        # Lazy window prune: keys whose last occurrence aged out.
+        for k in [
+            k for k, (e, _) in self._recent.items()
+            if now - (e.last_timestamp or e.timestamp) > self._window
+        ]:
+            del self._recent[k]
+
+        aggregated = self._recent.get(key)
+        if aggregated is not None:
+            ev, event_name = aggregated
+            ev.count += 1
+            ev.last_timestamp = now
+            if self._api is not None:
+                try:
+                    stored = self._api.get("events", namespace, event_name)
+                    stored["count"] = ev.count
+                    stored["lastTimestamp"] = now
+                    self._api.update("events", stored)
+                except Exception:  # events must never break reconciliation
+                    pass
+            self._notify(ev)
+            return
+
         ev = Event(
             type=type_,
             reason=reason,
-            message=truncate_message(message),
+            message=message,
             involved_kind=kind,
             involved_name=name,
             involved_namespace=namespace,
-            timestamp=self._clock(),
+            timestamp=now,
             source=self.source,
+            last_timestamp=now,
         )
+        event_name = f"{name}.{next(self._seq):08x}"
         self.events.append(ev)
+        self._recent[key] = (ev, event_name)
         if self._api is not None:
-            event_name = f"{name}.{next(self._seq):08x}"
             try:
                 self._api.create("events", ev.to_object(event_name))
             except Exception:  # events must never break reconciliation
                 pass
+        self._notify(ev)
 
     def eventf(self, obj: Any, type_: str, reason: str, fmt: str, *args: Any) -> None:
         self.event(obj, type_, reason, fmt % args if args else fmt)

@@ -22,12 +22,12 @@ step time without forcing a device sync per step.
 
 from __future__ import annotations
 
-import json
 import sys
 import time
 from typing import Callable, Optional, TextIO
 
 from . import metrics
+from .logging import emit_json
 
 # Train steps range from ~1ms (tiny CPU models in tests) to minutes
 # (large pods): wider buckets than the server-latency defaults.
@@ -178,11 +178,9 @@ class TrainingTelemetry:
 
     def emit(self, step: int) -> dict:
         rec = self.snapshot(step)
-        line = json.dumps(rec, sort_keys=True)
-        if self._file is not None:
-            self._file.write(line + "\n")
-        else:
-            print(line, file=self._stream)
+        # Shared structured-log writer: same sorted-keys one-object-per-line
+        # shape as before, with flush + write locking for free.
+        emit_json(rec, stream=self._file if self._file is not None else self._stream)
         return rec
 
     def close(self, step: int) -> Optional[dict]:

@@ -31,6 +31,87 @@ from typing import Iterator, Optional
 DEFAULT_CAPACITY = 2048
 
 
+class TraceContext:
+    """Serializable (trace id, parent span id) pair — the W3C traceparent
+    analog that crosses process boundaries in ``TPU_TRACE_CONTEXT``.
+
+    The controller encodes the context of its open builder span into pod
+    env; launcher/train parse it back and :func:`adopt_context` it, after
+    which every *root* span the process opens inherits the trace id and
+    parents under the stamping span.  Span ids stay process-local (they
+    are per-tracer counters); the trace id is the cross-process join key.
+    """
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def encode(self) -> str:
+        return f"{self.trace_id}-{self.span_id}"
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.encode()!r})"
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, TraceContext)
+            and other.trace_id == self.trace_id
+            and other.span_id == self.span_id
+        )
+
+    @classmethod
+    def parse(cls, value: Optional[str]) -> Optional["TraceContext"]:
+        """Decode ``"<trace_id>-<span_id>"``; None on anything malformed
+        (propagation is best-effort — a garbled env var must never break
+        worker startup)."""
+        if not value or not isinstance(value, str):
+            return None
+        parts = value.strip().split("-")
+        if len(parts) != 2 or not parts[0] or not parts[1]:
+            return None
+        return cls(parts[0], parts[1])
+
+    @classmethod
+    def from_environ(cls, environ=None) -> Optional["TraceContext"]:
+        """Read the propagation env var (``constants.ENV_TRACE_CONTEXT``)."""
+        import os
+
+        from ..api.v2beta1 import constants
+
+        env = os.environ if environ is None else environ
+        return cls.parse(env.get(constants.ENV_TRACE_CONTEXT))
+
+
+# Process-level inherited context (set once on startup from the pod env).
+# Root spans opened while this is set parent under the stamping process's
+# span instead of starting a fresh trace.
+_propagated: Optional[TraceContext] = None
+
+
+def adopt_context(ctx: Optional[TraceContext]) -> Optional[TraceContext]:
+    """Install ``ctx`` as the process-level inherited trace context and
+    return the previous one (so tests can restore; pass None to clear)."""
+    global _propagated
+    prev = _propagated
+    _propagated = ctx
+    return prev
+
+
+def adopt_from_environ(environ=None) -> Optional[TraceContext]:
+    """Adopt the trace context from the environment if one is present —
+    the launcher/train startup hook.  Returns the adopted context."""
+    ctx = TraceContext.from_environ(environ)
+    if ctx is not None:
+        adopt_context(ctx)
+    return ctx
+
+
+def propagated_context() -> Optional[TraceContext]:
+    return _propagated
+
+
 class Span:
     """One timed section. Mutable while open: ``span.annotate(k=v)`` adds
     attributes mid-flight (e.g. how many workers a reconcile created)."""
@@ -120,14 +201,15 @@ class Tracer:
         stack = self._stack()
         parent = stack[-1] if stack else None
         sid = self._next_id()
-        sp = Span(
-            name,
-            sid,
-            parent.span_id if parent else None,
-            parent.trace_id if parent else sid,
-            self._clock(),
-            attrs,
-        )
+        if parent is not None:
+            parent_id, trace_id = parent.span_id, parent.trace_id
+        elif _propagated is not None:
+            # Root span in a process that adopted a cross-process context:
+            # continue the inherited trace instead of starting a new one.
+            parent_id, trace_id = _propagated.span_id, _propagated.trace_id
+        else:
+            parent_id, trace_id = None, sid
+        sp = Span(name, sid, parent_id, trace_id, self._clock(), attrs)
         stack.append(sp)
         # While this span is open, module-level trace.span() calls on this
         # thread record into THIS tracer — library code (builders,
@@ -188,3 +270,14 @@ def span(name: str, **attrs):
     """Open a span on the active tracer (nests under the caller's open
     span when there is one; the process-default tracer otherwise)."""
     return current_tracer().span(name, **attrs)
+
+
+def current_context() -> Optional[TraceContext]:
+    """The context to propagate (or log) right now: the innermost open
+    span on this thread, else the process-level adopted context, else
+    None.  Builders call this to stamp pod env; the structured logger
+    calls it to attach ``trace_id`` to every record."""
+    sp = current_tracer().current()
+    if sp is not None:
+        return TraceContext(sp.trace_id, sp.span_id)
+    return _propagated

@@ -618,13 +618,20 @@ class TestTerminalStatusGuards:
         assert f.controller.jobs_failed.value() == 0
 
     def test_job_info_gauge_cleared_on_delete(self):
+        """job_info is a state metric now: recomputed from the informer
+        cache at scrape time, so a deleted job's series vanishes on the
+        next collect with no per-delete bookkeeping."""
         f = Fixture()
         job = make_synced_job(f, launcher=True)
-        assert f.controller.job_info.value("test-job-launcher", "default") == 1
+        sm = f.controller.state_metrics
+        labels = ("default", "test-job", "test-job-launcher", "v5e-16", "1")
+        sm.collect()
+        assert sm.job_info.value(*labels) == 1
         f.api.delete("tpujobs", "default", "test-job")
         f.controller.factory.pump_until_quiet()
         f.controller.sync_handler("default/test-job")
-        assert f.controller.job_info.value("test-job-launcher", "default") == 0
+        sm.collect()
+        assert sm.job_info.value(*labels) == 0
 
 
 class TestStatusUpdateConflict:

@@ -205,3 +205,115 @@ def test_scheduler_plugins_expose_framework_interface():
     assert len(names) == len(concrete), "plugin names must be distinct"
     # The default pipeline is built from these plugins.
     assert {p.name for p in plugin_mod.DEFAULT_PLUGINS} <= names
+
+
+def _package_calls():
+    """(relpath, lineno, callee-name, node) for every Call in the package
+    source, where callee-name is the bare function or attribute name."""
+    import ast
+
+    pkg = Path(__file__).resolve().parent.parent / "mpi_operator_tpu"
+    for path in sorted(pkg.rglob("*.py")):
+        rel = path.relative_to(pkg.parent)
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            callee = (
+                fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute)
+                else ""
+            )
+            yield str(rel).replace("\\", "/"), node.lineno, callee, node
+
+
+def test_no_bare_print_outside_cmd():
+    """Operator/runtime/scheduler code logs through the structured logger
+    (or emit_json for machine-readable line protocols); bare print() is
+    only legitimate in the cmd/ entrypoints, whose stdout IS the UI."""
+    offenders = [
+        f"{rel}:{line}: print() outside cmd/"
+        for rel, line, callee, _ in _package_calls()
+        if callee == "print" and not rel.startswith("mpi_operator_tpu/cmd/")
+    ]
+    assert not offenders, "\n".join(offenders)
+
+
+def test_loggers_come_from_structured_logging():
+    """Every logger handle comes from utils/logging.get_logger — stdlib
+    logging.getLogger would bypass the process-global sink (level/format
+    flags, trace_id attachment) and fragment the log stream."""
+    offenders = [
+        f"{rel}:{line}: logging.getLogger() bypasses utils/logging"
+        for rel, line, callee, _ in _package_calls()
+        if callee == "getLogger" and rel != "mpi_operator_tpu/utils/logging.py"
+    ]
+    assert not offenders, "\n".join(offenders)
+    # The sanctioned constructor is actually in use across the layers.
+    users = {
+        rel for rel, _, callee, _ in _package_calls() if callee == "get_logger"
+    }
+    for expected in (
+        "mpi_operator_tpu/controller/tpu_job_controller.py",
+        "mpi_operator_tpu/scheduler/core.py",
+        "mpi_operator_tpu/runtime/podrunner.py",
+        "mpi_operator_tpu/launcher/bootstrap.py",
+    ):
+        assert expected in users, f"{expected} must use get_logger"
+
+
+def _registered_gauges_with_labels():
+    """(file, lineno, name, label-names-or-None) for every literal
+    new_gauge registration; labels is None when not a literal tuple."""
+    import ast
+
+    found = []
+    for rel, line, callee, node in _package_calls():
+        if callee != "new_gauge":
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        labels_node = node.args[2] if len(node.args) > 2 else None
+        if labels_node is None:
+            for kw in node.keywords:
+                if kw.arg == "label_names":
+                    labels_node = kw.value
+        labels = None
+        if labels_node is None:
+            labels = ()
+        elif isinstance(labels_node, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in labels_node.elts
+        ):
+            labels = tuple(e.value for e in labels_node.elts)
+        found.append((rel, line, node.args[0].value, labels))
+    return found
+
+
+def test_gauge_naming_conventions():
+    """kube-state-metrics idiom: gauges never end in _total (that suffix
+    promises a counter), _info gauges carry identity as labels (constant
+    value 1 means the labels ARE the payload), and by_phase gauges
+    declare the phase label they enumerate."""
+    gauges = _registered_gauges_with_labels()
+    assert len(gauges) >= 5, "gauge registrations went missing"
+    bad = []
+    for file, line, name, labels in gauges:
+        where = f"{file}:{line} new_gauge({name!r})"
+        if name.endswith("_total"):
+            bad.append(f"{where}: _total suffix promises a counter")
+        if name.endswith("_info") and labels is not None and not labels:
+            bad.append(f"{where}: _info gauge needs identity labels")
+        if "by_phase" in name and labels is not None and "phase" not in labels:
+            bad.append(f"{where}: by_phase gauge must declare a phase label")
+    assert not bad, "\n".join(bad)
+    names = {name for _, _, name, _ in gauges}
+    # The state-metric family itself is registered.
+    assert {
+        "tpu_operator_job_info",
+        "tpu_operator_jobs_by_phase",
+        "tpu_operator_pods_by_phase",
+        "tpu_operator_job_condition",
+    } <= names
