@@ -117,6 +117,8 @@ def prune(obj: Any, schema: dict) -> Any:
 
 
 _TPUJOB_SCHEMA: dict = {}
+_CLUSTER_QUEUE_SCHEMA: dict = {}
+_LOCAL_QUEUE_SCHEMA: dict = {}
 
 
 def tpujob_openapi_schema() -> dict:
@@ -126,6 +128,36 @@ def tpujob_openapi_schema() -> dict:
 
         _TPUJOB_SCHEMA = openapi.tpujob_schema()
     return _TPUJOB_SCHEMA
+
+
+def clusterqueue_openapi_schema() -> dict:
+    global _CLUSTER_QUEUE_SCHEMA
+    if not _CLUSTER_QUEUE_SCHEMA:
+        from .v2beta1 import openapi
+
+        _CLUSTER_QUEUE_SCHEMA = openapi.clusterqueue_schema()
+    return _CLUSTER_QUEUE_SCHEMA
+
+
+def localqueue_openapi_schema() -> dict:
+    global _LOCAL_QUEUE_SCHEMA
+    if not _LOCAL_QUEUE_SCHEMA:
+        from .v2beta1 import openapi
+
+        _LOCAL_QUEUE_SCHEMA = openapi.localqueue_schema()
+    return _LOCAL_QUEUE_SCHEMA
+
+
+def admission_schema_for(resource: str):
+    """(schema, admission path) for a CRD-backed resource plural, or None
+    for builtins the in-memory apiserver stores schema-free."""
+    if resource == "tpujobs":
+        return tpujob_openapi_schema(), "tpujob"
+    if resource == "clusterqueues":
+        return clusterqueue_openapi_schema(), "clusterqueue"
+    if resource == "localqueues":
+        return localqueue_openapi_schema(), "localqueue"
+    return None
 
 
 def validate_tpujob_object(obj: dict) -> List[str]:

@@ -1113,11 +1113,14 @@ def job_status_schema() -> dict:
                         "type": _str(
                             enum=[
                                 types.JOB_CREATED,
+                                types.JOB_SCHEDULED,
                                 types.JOB_RUNNING,
                                 types.JOB_RESTARTING,
                                 types.JOB_SUSPENDED,
                                 types.JOB_SUCCEEDED,
                                 types.JOB_FAILED,
+                                types.JOB_QUOTA_RESERVED,
+                                types.JOB_QUEUE_NOT_FOUND,
                             ]
                         ),
                         "status": _str(enum=["True", "False", "Unknown"]),
@@ -1157,5 +1160,100 @@ def tpujob_schema() -> dict:
             "metadata": {"type": "object"},
             "spec": job_spec_schema(),
             "status": job_status_schema(),
+        },
+    }
+
+
+def clusterqueue_schema() -> dict:
+    """openAPIV3Schema for the ClusterQueue CRD (Kueue analog, chip-only)."""
+    return {
+        "type": "object",
+        "properties": {
+            "apiVersion": _str(),
+            "kind": _str(),
+            "metadata": {"type": "object"},
+            "spec": {
+                "type": "object",
+                "required": ["quotas"],
+                "properties": {
+                    "cohort": _str(
+                        "Cohort name; member queues lend unused quota to "
+                        "each other.",
+                        pattern=DNS1123,
+                    ),
+                    "quotas": {
+                        "type": "array",
+                        "minItems": 1,
+                        "items": {
+                            "type": "object",
+                            "required": ["generation", "nominalQuota"],
+                            "properties": {
+                                "generation": _str(
+                                    "TPU generation, e.g. v5e, v5p, v4.",
+                                    pattern=r"^v[0-9]+[a-z]*$",
+                                ),
+                                "nominalQuota": _int(
+                                    "Chips this queue owns outright.",
+                                    minimum=0,
+                                ),
+                                "borrowingLimit": _int(
+                                    "Max chips borrowable from the cohort "
+                                    "on top of nominalQuota (unset = "
+                                    "unbounded).",
+                                    minimum=0,
+                                ),
+                            },
+                        },
+                    },
+                    "preemption": {
+                        "type": "object",
+                        "properties": {
+                            "reclaimWithinCohort": _str(
+                                "Whether lent quota is reclaimed by "
+                                "evicting cohort borrowers.",
+                                enum=["Never", "Any"],
+                            ),
+                        },
+                    },
+                },
+            },
+            "status": {
+                "type": "object",
+                "properties": {
+                    "pendingWorkloads": _int(minimum=0),
+                    "admittedWorkloads": _int(minimum=0),
+                    "usage": {
+                        "type": "object",
+                        "description": "generation -> admitted chips.",
+                        "additionalProperties": {
+                            "type": "integer",
+                            "format": "int32",
+                        },
+                    },
+                },
+            },
+        },
+    }
+
+
+def localqueue_schema() -> dict:
+    """openAPIV3Schema for the LocalQueue CRD (namespace -> ClusterQueue)."""
+    return {
+        "type": "object",
+        "properties": {
+            "apiVersion": _str(),
+            "kind": _str(),
+            "metadata": {"type": "object"},
+            "spec": {
+                "type": "object",
+                "required": ["clusterQueue"],
+                "properties": {
+                    "clusterQueue": _str(
+                        "Name of the ClusterQueue this LocalQueue admits "
+                        "into.",
+                        pattern=DNS1123,
+                    ),
+                },
+            },
         },
     }

@@ -60,6 +60,10 @@ JOB_RESTARTING = "Restarting"
 JOB_SUSPENDED = "Suspended"
 JOB_SUCCEEDED = "Succeeded"
 JOB_FAILED = "Failed"
+# Admission-queue condition types (Kueue Workload-condition analogs,
+# written by queue/manager.py when --enable-queue is on).
+JOB_QUOTA_RESERVED = "QuotaReserved"
+JOB_QUEUE_NOT_FOUND = "QueueNotFound"
 
 
 @dataclass

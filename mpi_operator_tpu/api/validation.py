@@ -194,6 +194,12 @@ def _validate_run_policy(policy: RunPolicy, path: str) -> list[FieldError]:
     ):
         if value is not None and value < 0:
             errs.append(invalid(f"{path}.{name}", value, "must be greater than or equal to 0"))
+    sp = policy.scheduling_policy
+    if sp is not None and sp.queue:
+        for detail in is_dns1123_label(sp.queue):
+            errs.append(
+                invalid(f"{path}.schedulingPolicy.queue", sp.queue, detail)
+            )
     return errs
 
 

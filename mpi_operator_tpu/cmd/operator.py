@@ -73,6 +73,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "The default fits the shipped examples; a gang "
                         "whose acceleratorType matches no slice stays "
                         "Unschedulable until the inventory does")
+    p.add_argument("--enable-queue", action="store_true",
+                   help="run the in-process admission queue (memory backend "
+                        "only): TPUJobs naming a LocalQueue via "
+                        "runPolicy.schedulingPolicy.queue start suspended "
+                        "and are admitted against ClusterQueue chip quotas; "
+                        "off = suspend is user-driven (pre-queue behaviour)")
+    p.add_argument("--cluster-queue", action="append", default=[],
+                   help="bootstrap ClusterQueue(s), "
+                        "'name[@cohort]:gen=chips[,gen=chips...]' "
+                        "(e.g. 'team-a@research:v5e=16,v5p=8'); also creates "
+                        "a same-named LocalQueue in the watched namespace "
+                        "(or 'default'). Repeatable; existing queues are "
+                        "left untouched")
     p.add_argument("--log-level", default="info",
                    choices=["debug", "info", "warning", "error"],
                    help="structured-log severity threshold")
@@ -246,6 +259,16 @@ def run(argv=None) -> int:
             file=sys.stderr,
         )
         return 1
+    if args.enable_queue and args.backend != "memory":
+        print(
+            "--enable-queue requires --backend memory (point a real cluster "
+            "at sigs.k8s.io/kueue instead)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.cluster_queue and not args.enable_queue:
+        print("--cluster-queue requires --enable-queue", file=sys.stderr)
+        return 1
 
     api, runner = build_backend(args)
     check_crd_exists(api, args.namespace)
@@ -291,6 +314,20 @@ def run(argv=None) -> int:
         # an external gang scheduler explicitly.
         if not args.gang_scheduling:
             args.gang_scheduling = DEFAULT_SCHEDULER_NAME
+    queue_manager = None
+    if args.enable_queue:
+        from ..queue import QueueManager, bootstrap_queues
+
+        try:
+            bootstrap_queues(api, args.cluster_queue, args.namespace)
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 1
+        for spec in args.cluster_queue:
+            print(f"queue: bootstrapped ClusterQueue {spec.split(':')[0]!r}")
+        queue_manager = QueueManager(
+            api, registry=registry, flight_recorder=recorder
+        )
     controller = TPUJobController(
         api,
         namespace=args.namespace,
@@ -354,6 +391,15 @@ def run(argv=None) -> int:
         controller.run(threadiness=args.threadiness, stop=lost)
 
     threads = []
+    if queue_manager is not None:
+        # Like the in-process scheduler, admission is not leadership-gated:
+        # the memory backend is single-process, so there is exactly one
+        # suspend writer either way.
+        threads.append(
+            threading.Thread(
+                target=lambda: queue_manager.run(1, stop), daemon=True
+            )
+        )
     elector = None
     if args.leader_elect:
         elector = LeaderElector(

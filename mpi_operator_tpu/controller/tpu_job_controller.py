@@ -930,6 +930,10 @@ class TPUJobController:
         st.initialize_replica_statuses(job, REPLICA_TYPE_WORKER)
         if REPLICA_TYPE_LAUNCHER in job.spec.replica_specs:
             st.initialize_replica_statuses(job, REPLICA_TYPE_LAUNCHER)
+        # A suspended job has no running wall-clock: startTime resets here
+        # and is re-stamped on resume (batch/v1 Job suspend semantics;
+        # activeDeadlineSeconds must not tick while suspended).
+        job.status.start_time = None
         if old_status is None or job.status.to_dict() != old_status:
             self.update_status_handler(job)
 

@@ -1,0 +1,20 @@
+"""In-process Kueue analog: multi-tenant quota & admission queueing.
+
+TPUJobs that name a LocalQueue (``spec.runPolicy.schedulingPolicy.queue``)
+are created suspended and admitted by the QueueManager flipping
+``runPolicy.suspend`` once chip quota is reserved in their ClusterQueue —
+the same suspend-based handshake the reference operator delegates to
+sigs.k8s.io/kueue.
+
+- quota.py   — chip-denominated usage ledger with cohort borrowing and
+               reclaim accounting (release-then-reserve discipline, like
+               scheduler/cache.py).
+- manager.py — the QueueManager controller: watches TPUJobs + queues,
+               admits priority-then-FIFO, evicts borrowers on reclaim.
+
+The QueueManager is the single writer of ``suspend`` while enabled
+(enforced by a lint rule in tests/test_lint.py).
+"""
+
+from .manager import QueueManager, bootstrap_queues, parse_cluster_queue_spec  # noqa: F401
+from .quota import QuotaLedger, insufficient_quota_message  # noqa: F401

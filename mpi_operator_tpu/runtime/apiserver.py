@@ -101,6 +101,8 @@ RESOURCES: dict[str, ResourceType] = {
         ResourceType("leases", "coordination.k8s.io/v1", "Lease"),
         ResourceType("podgroups", "scheduling.x-k8s.io/v1alpha1", "PodGroup"),
         ResourceType("tpujobs", "kubeflow.org/v2beta1", "TPUJob"),
+        ResourceType("clusterqueues", "kubeflow.org/v2beta1", "ClusterQueue"),
+        ResourceType("localqueues", "kubeflow.org/v2beta1", "LocalQueue"),
     ]
 }
 
@@ -214,27 +216,26 @@ class InMemoryAPIServer:
     # -- CRUD ------------------------------------------------------------
 
     def _admit(self, resource: str, obj: dict) -> dict:
-        """CRD structural-schema admission (real-apiserver analog): TPUJob
-        writes are validated against the generated openAPIV3Schema — a
-        malformed pod template fails here, at create/update time, not
+        """CRD structural-schema admission (real-apiserver analog): writes
+        to CRD-backed resources (TPUJob, ClusterQueue, LocalQueue) are
+        validated against the generated openAPIV3Schema — a malformed pod
+        template or quota entry fails here, at create/update time, not
         later at pod-creation time — and unknown fields are pruned the
         way a real apiserver prunes them (typos never reach storage)."""
-        if resource != "tpujobs":
-            return obj
-        from ..api.schema import (
-            prune,
-            tpujob_openapi_schema,
-            validate_tpujob_object,
-        )
+        from ..api.schema import admission_schema_for, prune, validate_schema
 
-        errors = validate_tpujob_object(obj)
+        admission = admission_schema_for(resource)
+        if admission is None:
+            return obj
+        schema, path = admission
+        errors = validate_schema(obj, schema, path=path)
         if errors:
             name = self._key(obj)[1]
             shown = "; ".join(errors[:5])
             if len(errors) > 5:
                 shown += f" (+{len(errors) - 5} more)"
             raise InvalidError(resource, name, shown)
-        return prune(obj, tpujob_openapi_schema())
+        return prune(obj, schema)
 
     def create(self, resource: str, obj: dict) -> dict:
         self._check_resource(resource)

@@ -70,7 +70,8 @@ class StateMetrics:
         self.job_info = metrics.new_gauge(
             "tpu_operator_job_info",
             "Identity of each TPUJob known to the informer cache (value 1)",
-            ("namespace", "tpujob", "launcher", "accelerator_type", "num_slices"),
+            ("namespace", "tpujob", "launcher", "accelerator_type",
+             "num_slices", "queue"),
             registry,
         )
         self.jobs_by_phase = metrics.new_gauge(
@@ -110,6 +111,9 @@ class StateMetrics:
             spec = job.get("spec") or {}
             tpu = spec.get("tpu") or {}
             has_launcher = "Launcher" in (spec.get("tpuReplicaSpecs") or {})
+            scheduling = (
+                (spec.get("runPolicy") or {}).get("schedulingPolicy") or {}
+            )
             self.job_info.set(
                 1.0,
                 ns,
@@ -117,6 +121,7 @@ class StateMetrics:
                 (name + constants.LAUNCHER_SUFFIX) if has_launcher else "",
                 tpu.get("acceleratorType", ""),
                 str(tpu.get("numSlices", 1)),
+                scheduling.get("queue", ""),
             )
             phase = job_phase(job)
             job_counts[phase] = job_counts.get(phase, 0) + 1

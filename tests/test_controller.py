@@ -469,6 +469,48 @@ class TestSuspendResume:
         assert not st.is_suspended(refreshed.status)
         assert ("Normal", "TPUJobResumed") in f.events()
 
+    def test_suspend_running_job_resets_start_time_and_deletes_launcher(self):
+        """batch/v1 Job suspend semantics: suspending a running job tears
+        down the launcher Job too (not just workers) and clears
+        status.startTime so no wall-clock accrues while suspended; resume
+        re-stamps it."""
+        f = Fixture()
+        job = make_synced_job(f, launcher=True)
+        assert f.get_job().status.start_time == NOW
+        assert len(f.api.list("jobs")) == 1
+        jd = f.api.get("tpujobs", "default", "test-job")
+        jd["spec"]["runPolicy"] = {"suspend": True, "cleanPodPolicy": "None"}
+        f.api.update("tpujobs", jd)
+        f.sync(job)
+        f.controller.factory.pump_until_quiet()
+        assert f.api.list("pods") == []
+        assert f.api.list("jobs") == []
+        refreshed = f.get_job()
+        assert st.is_suspended(refreshed.status)
+        assert refreshed.status.start_time is None
+        # Resume stamps a fresh startTime at resume-time, not create-time.
+        f.time[0] = NOW + 50
+        jd = f.api.get("tpujobs", "default", "test-job")
+        jd["spec"]["runPolicy"] = {"suspend": False, "cleanPodPolicy": "None"}
+        f.api.update("tpujobs", jd)
+        f.sync(job)
+        assert f.get_job().status.start_time == NOW + 50
+
+    def test_suspended_condition_and_event_exactly_once(self):
+        """Resyncing a suspended job must not re-append the Suspended
+        condition or re-fire the event (idempotent reconcile)."""
+        f = Fixture()
+        job = make_synced_job(f)
+        jd = f.api.get("tpujobs", "default", "test-job")
+        jd["spec"]["runPolicy"] = {"suspend": True, "cleanPodPolicy": "None"}
+        f.api.update("tpujobs", jd)
+        for _ in range(3):
+            f.sync(job)
+        refreshed = f.get_job()
+        held = [c for c in refreshed.status.conditions if c.type == "Suspended"]
+        assert len(held) == 1 and held[0].status == "True"
+        assert f.events().count(("Normal", "TPUJobSuspended")) == 1
+
 
 class TestGangScheduling:
     def test_podgroup_created_with_full_gang(self):
@@ -624,7 +666,7 @@ class TestTerminalStatusGuards:
         f = Fixture()
         job = make_synced_job(f, launcher=True)
         sm = f.controller.state_metrics
-        labels = ("default", "test-job", "test-job-launcher", "v5e-16", "1")
+        labels = ("default", "test-job", "test-job-launcher", "v5e-16", "1", "")
         sm.collect()
         assert sm.job_info.value(*labels) == 1
         f.api.delete("tpujobs", "default", "test-job")

@@ -207,6 +207,77 @@ def test_scheduler_plugins_expose_framework_interface():
     assert {p.name for p in plugin_mod.DEFAULT_PLUGINS} <= names
 
 
+def test_queue_metrics_carry_subsystem_prefix():
+    """Every metric registered under mpi_operator_tpu/queue/ must use the
+    tpu_operator_queue_ subsystem prefix (one-matcher dashboards, like
+    the scheduler), and the queue must register its advertised quartet."""
+    queue_metrics = [
+        (file, line, kind, name)
+        for file, line, kind, name in _registered_metric_names()
+        if str(file).replace("\\", "/").startswith("mpi_operator_tpu/queue/")
+    ]
+    assert queue_metrics, "queue metric registrations went missing"
+    bad = [
+        f"{file}:{line} {kind}({name!r}): missing tpu_operator_queue_ prefix"
+        for file, line, kind, name in queue_metrics
+        if not name.startswith("tpu_operator_queue_")
+    ]
+    assert not bad, "\n".join(bad)
+    names = {name for _, _, _, name in queue_metrics}
+    assert {
+        "tpu_operator_queue_pending_workloads",
+        "tpu_operator_queue_admitted_workloads",
+        "tpu_operator_queue_admission_duration_seconds",
+        "tpu_operator_queue_evictions_total",
+    } <= names
+
+
+def test_suspend_writes_confined_to_queue_package():
+    """While the admission queue is enabled the QueueManager is the single
+    writer of ``runPolicy.suspend`` — a second writer elsewhere in the
+    operator would fight it (admit/evict flapping).  Enforced at the AST
+    level: no assignment targets ``.suspend`` / ``["suspend"]`` outside
+    mpi_operator_tpu/queue/, except the API types' own (de)serialization."""
+    import ast
+
+    allowed_prefixes = (
+        "mpi_operator_tpu/queue/",
+        # The dataclass's field definition and to_dict/from_dict round-trip.
+        "mpi_operator_tpu/api/v2beta1/types.py",
+    )
+
+    def writes_suspend(target) -> bool:
+        if isinstance(target, ast.Attribute) and target.attr == "suspend":
+            return True
+        if (isinstance(target, ast.Subscript)
+                and isinstance(target.slice, ast.Constant)
+                and target.slice.value == "suspend"):
+            return True
+        if isinstance(target, (ast.Tuple, ast.List)):
+            return any(writes_suspend(e) for e in target.elts)
+        return False
+
+    pkg = Path(__file__).resolve().parent.parent / "mpi_operator_tpu"
+    offenders = []
+    for path in sorted(pkg.rglob("*.py")):
+        rel = str(path.relative_to(pkg.parent)).replace("\\", "/")
+        if rel.startswith(allowed_prefixes[0]) or rel == allowed_prefixes[1]:
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for target in targets:
+                if writes_suspend(target):
+                    offenders.append(
+                        f"{rel}:{node.lineno}: suspend write outside queue/"
+                    )
+    assert not offenders, "\n".join(offenders)
+
+
 def _package_calls():
     """(relpath, lineno, callee-name, node) for every Call in the package
     source, where callee-name is the bare function or attribute name."""

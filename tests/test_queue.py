@@ -1,0 +1,525 @@
+"""Admission-queue tests: QueueManager + TPUJobController end to end.
+
+The fixture runs both control loops against one in-memory apiserver,
+sharing a registry and flight recorder the way cmd/operator.py wires
+them, and drives them synchronously (manager pass, then controller
+pass) so every assertion reads deterministic state.  The QuotaLedger
+invariants are property-tested with seeded random interleavings.
+"""
+
+import random
+
+import pytest
+
+from mpi_operator_tpu.api.v2beta1 import (
+    JOB_QUEUE_NOT_FOUND,
+    JOB_QUOTA_RESERVED,
+    REPLICA_TYPE_WORKER,
+    ReplicaSpec,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+)
+from mpi_operator_tpu.api.v2beta1.types import SchedulingPolicy
+from mpi_operator_tpu.controller import builders
+from mpi_operator_tpu.controller import status as st
+from mpi_operator_tpu.controller.tpu_job_controller import TPUJobController
+from mpi_operator_tpu.queue import (
+    QueueManager,
+    QuotaLedger,
+    bootstrap_queues,
+    insufficient_quota_message,
+    parse_cluster_queue_spec,
+)
+from mpi_operator_tpu.queue.quota import QueueQuota
+from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer, InvalidError
+from mpi_operator_tpu.utils import flightrecorder, metrics
+
+TEMPLATE = {"spec": {"containers": [{"name": "main", "image": "tpu-image"}]}}
+NOW = 1000.0
+
+
+def gauge_value(registry: metrics.Registry, name: str, queue: str) -> float:
+    """Read one cluster_queue-labelled series out of a real scrape, so the
+    assertion covers exactly what a Prometheus poll would see."""
+    needle = f'{name}{{cluster_queue="{queue}"}}'
+    for line in registry.expose().splitlines():
+        if line.startswith(needle):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+class Fixture:
+    """One apiserver, both control loops, shared observability."""
+
+    def __init__(self):
+        self.time = [NOW]
+        clock = lambda: self.time[0]  # noqa: E731
+        self.api = InMemoryAPIServer(clock=clock)
+        self.registry = metrics.Registry()
+        self.flight = flightrecorder.FlightRecorder(clock=clock)
+        self.controller = TPUJobController(
+            self.api, registry=self.registry, flight_recorder=self.flight,
+            clock=clock,
+        )
+        self.manager = QueueManager(
+            self.api, registry=self.registry, flight_recorder=self.flight,
+            clock=clock,
+        )
+        self.controller.start()
+        self.manager.start()
+
+    def settle(self, rounds: int = 4):
+        """Admission pass then reconcile pass, repeated until the writes
+        each loop makes stop generating work for the other."""
+        for _ in range(rounds):
+            self.manager.sync_pending()
+            self.controller.sync_pending()
+
+    def create_cluster_queue(self, name, cohort="", reclaim="Never",
+                             **quotas):
+        """quotas: generation=nominal or generation=(nominal, borrowLimit)."""
+        entries = []
+        for gen, q in quotas.items():
+            if isinstance(q, tuple):
+                entries.append({"generation": gen, "nominalQuota": q[0],
+                                "borrowingLimit": q[1]})
+            else:
+                entries.append({"generation": gen, "nominalQuota": q})
+        spec = {"quotas": entries,
+                "preemption": {"reclaimWithinCohort": reclaim}}
+        if cohort:
+            spec["cohort"] = cohort
+        return self.api.create(
+            "clusterqueues", {"metadata": {"name": name}, "spec": spec}
+        )
+
+    def create_local_queue(self, name, cluster_queue, namespace="default"):
+        return self.api.create("localqueues", {
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {"clusterQueue": cluster_queue},
+        })
+
+    def new_job(self, name, queue, workers=4, accelerator_type="v5e-16",
+                priority_class=""):
+        job = TPUJob()
+        job.metadata.name = name
+        job.metadata.namespace = "default"
+        job.spec = TPUJobSpec(
+            tpu=TPUSpec(accelerator_type=accelerator_type),
+            replica_specs={
+                REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=workers, template=dict(TEMPLATE)
+                )
+            },
+        )
+        job.spec.run_policy.clean_pod_policy = "None"
+        job.spec.run_policy.scheduling_policy = SchedulingPolicy(
+            queue=queue, priority_class=priority_class
+        )
+        return self.controller.tpujobs.tpujobs("default").create(job)
+
+    def get_job(self, name) -> TPUJob:
+        return self.controller.tpujobs.tpujobs("default").get(name)
+
+    def worker_pods(self, name):
+        return [p for p in self.api.list("pods")
+                if p["metadata"]["name"].startswith(f"{name}-worker-")]
+
+    def finish_job(self, job_name):
+        """Drive a launcher-less job to Succeeded via its worker pods."""
+        job = self.get_job(job_name)
+        for i in range(builders.worker_replicas(job)):
+            pod = self.api.get("pods", "default", builders.worker_name(job, i))
+            pod["status"] = {"phase": "Succeeded"}
+            self.api.update_status("pods", pod)
+
+    def condition(self, job_name, type_):
+        return st.get_condition(self.get_job(job_name).status, type_)
+
+    def events(self, source):
+        return [(e.reason, e.involved_name) for e in source.recorder.events]
+
+
+# ----------------------------------------------------------------------
+# Bootstrap / flag parsing
+# ----------------------------------------------------------------------
+
+
+class TestBootstrap:
+    def test_parse_spec_full(self):
+        cq = parse_cluster_queue_spec("team-a@research:v5e=16,v5p=8")
+        assert cq.name == "team-a"
+        assert cq.spec.cohort == "research"
+        assert {q.generation: q.nominal_quota for q in cq.spec.quotas} == {
+            "v5e": 16, "v5p": 8,
+        }
+        assert cq.spec.preemption.reclaim_within_cohort == "Any"
+
+    def test_parse_spec_minimal(self):
+        cq = parse_cluster_queue_spec("solo:v4=32")
+        assert cq.name == "solo" and cq.spec.cohort == ""
+
+    @pytest.mark.parametrize("bad", [
+        "noquota", "name:", ":v5e=16", "q:v5e", "q:v5e=lots",
+    ])
+    def test_parse_spec_rejects(self, bad):
+        with pytest.raises(ValueError, match="--cluster-queue"):
+            parse_cluster_queue_spec(bad)
+
+    def test_bootstrap_creates_queue_pair_idempotently(self):
+        api = InMemoryAPIServer()
+        bootstrap_queues(api, ["team-a:v5e=16"], namespace="training")
+        bootstrap_queues(api, ["team-a:v5e=16"])  # rerun: AlreadyExists is fine
+        assert len(api.list("clusterqueues")) == 1
+        lq = api.get("localqueues", "training", "team-a")
+        assert lq["spec"]["clusterQueue"] == "team-a"
+
+    def test_schema_admission_rejects_bad_queue(self):
+        api = InMemoryAPIServer()
+        with pytest.raises(InvalidError):
+            api.create("clusterqueues", {
+                "metadata": {"name": "bad"},
+                "spec": {"quotas": [{"generation": "v5e"}]},  # no nominalQuota
+            })
+        with pytest.raises(InvalidError):
+            api.create("localqueues", {
+                "metadata": {"name": "lq", "namespace": "default"},
+                "spec": {},  # clusterQueue required
+            })
+
+
+# ----------------------------------------------------------------------
+# End-to-end admission
+# ----------------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_two_jobs_one_slot_fifo_then_auto_admit(self):
+        """The acceptance-criteria scenario: quota for one of two jobs —
+        first admitted and running, second suspended with the kube-style
+        insufficient-quota message, auto-admitted when the first
+        finishes."""
+        f = Fixture()
+        f.create_cluster_queue("team-a", v5e=16)
+        f.create_local_queue("team-a", "team-a")
+        f.new_job("job-1", "team-a")
+        f.time[0] += 1
+        f.new_job("job-2", "team-a")
+        f.settle()
+
+        first = f.get_job("job-1")
+        assert first.spec.run_policy.suspend is False
+        assert st.has_condition(first.status, JOB_QUOTA_RESERVED)
+        assert len(f.worker_pods("job-1")) == 4
+
+        second = f.get_job("job-2")
+        assert second.spec.run_policy.suspend is True
+        assert st.is_suspended(second.status)
+        assert f.worker_pods("job-2") == []
+        cond = f.condition("job-2", JOB_QUOTA_RESERVED)
+        assert cond.status == "False" and cond.reason == "Pending"
+        assert cond.message == insufficient_quota_message("team-a", "v5e", 16, 0)
+
+        assert gauge_value(f.registry,
+                           "tpu_operator_queue_pending_workloads", "team-a") == 1
+        assert gauge_value(f.registry,
+                           "tpu_operator_queue_admitted_workloads", "team-a") == 1
+
+        # First job completes: its charge drops and job-2 auto-admits.
+        f.time[0] += 10
+        f.finish_job("job-1")
+        f.settle()
+        assert st.is_finished(f.get_job("job-1").status)
+        second = f.get_job("job-2")
+        assert second.spec.run_policy.suspend is False
+        assert st.has_condition(second.status, JOB_QUOTA_RESERVED)
+        assert len(f.worker_pods("job-2")) == 4
+        assert gauge_value(f.registry,
+                           "tpu_operator_queue_pending_workloads", "team-a") == 0
+        assert gauge_value(f.registry,
+                           "tpu_operator_queue_admitted_workloads", "team-a") == 1
+
+        # The flight recorder holds the whole story for job-2, in order:
+        # gated -> pending on quota -> admitted, with seq strictly rising.
+        timeline = f.flight.timeline("default", "job-2")
+        reasons = [e["reason"] for e in timeline]
+        assert reasons.index("SuspendedByQueue") < reasons.index("Pending")
+        assert reasons.index("Pending") < reasons.index("Admitted")
+        assert [e["seq"] for e in timeline] == sorted(e["seq"] for e in timeline)
+
+    def test_cluster_queue_status_mirrors_usage(self):
+        f = Fixture()
+        f.create_cluster_queue("team-a", v5e=32)
+        f.create_local_queue("team-a", "team-a")
+        f.new_job("job-1", "team-a")
+        f.settle()
+        cq = f.api.get("clusterqueues", "", "team-a")
+        assert cq["status"]["admittedWorkloads"] == 1
+        assert cq["status"]["usage"] == {"v5e": 16}
+
+    def test_priority_beats_fifo(self):
+        f = Fixture()
+        f.create_cluster_queue("team-a", v5e=16)
+        f.create_local_queue("team-a", "team-a")
+        f.new_job("first-low", "team-a", priority_class="low-priority")
+        f.time[0] += 1
+        f.new_job("later-high", "team-a", priority_class="high-priority")
+        f.settle()
+        assert st.has_condition(
+            f.get_job("later-high").status, JOB_QUOTA_RESERVED
+        )
+        assert f.get_job("first-low").spec.run_policy.suspend is True
+
+    def test_strict_fifo_blocks_out_of_order_admission(self):
+        """A small job must not slip past a larger one ahead of it."""
+        f = Fixture()
+        f.create_cluster_queue("team-a", v5e=16)
+        f.create_local_queue("team-a", "team-a")
+        f.new_job("big", "team-a", accelerator_type="v5e-32", workers=8)
+        f.time[0] += 1
+        f.new_job("small", "team-a", accelerator_type="v5e-16")
+        f.settle()
+        assert f.get_job("big").spec.run_policy.suspend is True
+        assert f.get_job("small").spec.run_policy.suspend is True
+        cond = f.condition("small", JOB_QUOTA_RESERVED)
+        assert "1 workload(s) ahead" in cond.message
+
+    def test_queue_not_found_is_pending_not_a_crash(self):
+        f = Fixture()
+        f.new_job("orphan", "no-such-queue")
+        f.settle()
+        job = f.get_job("orphan")
+        assert job.spec.run_policy.suspend is True  # gated anyway
+        cond = f.condition("orphan", JOB_QUEUE_NOT_FOUND)
+        assert cond.status == "True"
+        assert cond.message == "LocalQueue default/no-such-queue not found"
+        assert f.worker_pods("orphan") == []
+
+        # A LocalQueue pointing at a missing ClusterQueue names the gap.
+        # The condition's status+reason are unchanged so the message stays
+        # (kube condition semantics); the refined diagnosis lands as a
+        # fresh Event instead.
+        f.create_local_queue("no-such-queue", "ghost-cq")
+        f.settle()
+        assert any(
+            "ClusterQueue ghost-cq" in e.message
+            for e in f.manager.recorder.events
+        )
+
+        # Once the chain resolves, the condition clears and the job runs.
+        f.create_cluster_queue("ghost-cq", v5e=16)
+        f.settle()
+        cond = f.condition("orphan", JOB_QUEUE_NOT_FOUND)
+        assert cond.status == "False" and cond.reason == "QueueFound"
+        assert st.has_condition(f.get_job("orphan").status, JOB_QUOTA_RESERVED)
+        assert len(f.worker_pods("orphan")) == 4
+
+
+# ----------------------------------------------------------------------
+# Borrowing + reclaim preemption
+# ----------------------------------------------------------------------
+
+
+class TestReclaim:
+    def test_borrow_then_lender_reclaims_youngest_borrower(self):
+        """Acceptance scenario 2: team-b borrows team-a's idle chips; when
+        team-a's own workload arrives the youngest borrower is evicted
+        (suspend flips back, workers torn down), the chips return, and
+        the borrower is readmitted once quota frees up again."""
+        f = Fixture()
+        f.create_cluster_queue("team-a", cohort="research", reclaim="Any",
+                               v5e=16)
+        f.create_cluster_queue("team-b", cohort="research", reclaim="Any",
+                               v5e=16)
+        f.create_local_queue("team-a", "team-a")
+        f.create_local_queue("team-b", "team-b")
+
+        f.new_job("b-nominal", "team-b")
+        f.settle()
+        f.time[0] += 1
+        f.new_job("b-borrow", "team-b")  # 16 chips over nominal: borrows
+        f.settle()
+        assert gauge_value(f.registry,
+                           "tpu_operator_queue_admitted_workloads", "team-b") == 2
+        assert len(f.worker_pods("b-borrow")) == 4
+
+        # The lender's own workload arrives and reclaims.
+        f.time[0] += 1
+        f.new_job("a-owner", "team-a")
+        f.settle()
+
+        evicted = f.get_job("b-borrow")
+        assert evicted.spec.run_policy.suspend is True
+        # The live condition has already moved on to Pending (an evicted
+        # workload is just a waiting one); the eviction itself is durable
+        # in the flight recorder with the reclaim message.
+        cond = f.condition("b-borrow", JOB_QUOTA_RESERVED)
+        assert cond.status == "False"
+        evictions = [
+            e for e in f.flight.timeline("default", "b-borrow")
+            if e["reason"] == "Evicted"
+        ]
+        assert evictions
+        assert "reclaimed 16 borrowed google.com/tpu" in evictions[0]["message"]
+        assert f.worker_pods("b-borrow") == []
+        # The older borrower-queue job inside nominal is untouched.
+        assert st.has_condition(f.get_job("b-nominal").status, JOB_QUOTA_RESERVED)
+        assert st.has_condition(f.get_job("a-owner").status, JOB_QUOTA_RESERVED)
+        assert len(f.worker_pods("a-owner")) == 4
+        assert gauge_value(f.registry,
+                           "tpu_operator_queue_evictions_total", "team-b") == 1
+        assert gauge_value(f.registry,
+                           "tpu_operator_queue_pending_workloads", "team-b") == 1
+
+        # team-b's nominal job finishes: the evicted borrower comes back.
+        f.time[0] += 10
+        f.finish_job("b-nominal")
+        f.settle()
+        readmitted = f.get_job("b-borrow")
+        assert readmitted.spec.run_policy.suspend is False
+        assert st.has_condition(readmitted.status, JOB_QUOTA_RESERVED)
+        assert len(f.worker_pods("b-borrow")) == 4
+
+        # Flight-recorder timeline for the borrower reads admit -> evict ->
+        # readmit in order.
+        reasons = [
+            e["reason"] for e in f.flight.timeline("default", "b-borrow")
+            if e["reason"] in ("Admitted", "Evicted")
+        ]
+        assert reasons[0] == "Admitted"
+        assert "Evicted" in reasons
+        assert reasons[-1] == "Admitted"
+
+    def test_reclaim_never_does_not_evict(self):
+        f = Fixture()
+        f.create_cluster_queue("team-a", cohort="research", reclaim="Never",
+                               v5e=16)
+        f.create_cluster_queue("team-b", cohort="research", reclaim="Any",
+                               v5e=16)
+        f.create_local_queue("team-a", "team-a")
+        f.create_local_queue("team-b", "team-b")
+        f.new_job("b-borrow", "team-b")
+        f.settle()
+        f.time[0] += 1
+        f.new_job("b-borrow-2", "team-b")
+        f.settle()
+        f.time[0] += 1
+        f.new_job("a-owner", "team-a")
+        f.settle()
+        # team-a declared Never: its workload waits instead of evicting.
+        cond = f.condition("a-owner", JOB_QUOTA_RESERVED)
+        assert cond.status == "False" and cond.reason == "Pending"
+        assert st.has_condition(f.get_job("b-borrow-2").status, JOB_QUOTA_RESERVED)
+
+    def test_borrowing_limit_caps_borrowing(self):
+        f = Fixture()
+        f.create_cluster_queue("team-a", cohort="research", v5e=16)
+        f.create_cluster_queue("team-b", cohort="research", v5e=(0, 8))
+        f.create_local_queue("team-b", "team-b")
+        f.new_job("b-wants-16", "team-b")  # needs 16, may borrow only 8
+        f.settle()
+        cond = f.condition("b-wants-16", JOB_QUOTA_RESERVED)
+        assert cond.status == "False"
+        assert cond.message == insufficient_quota_message("team-b", "v5e", 16, 8)
+
+
+# ----------------------------------------------------------------------
+# QuotaLedger invariants (property-style)
+# ----------------------------------------------------------------------
+
+
+def ledger_invariants(ledger: QuotaLedger, limits):
+    """usage == sum of live charges, never negative, borrowing within
+    limits, cohort never oversubscribed."""
+    want = {}
+    for charge in ledger.charges().values():
+        slot = (charge.queue, charge.generation)
+        want[slot] = want.get(slot, 0) + charge.chips
+    have = {
+        (q, g): ledger.usage(q, g)
+        for q in ledger.queues()
+        for g in ("v5e", "v5p")
+        if ledger.usage(q, g)
+    }
+    assert have == {k: v for k, v in want.items() if v}
+    for (queue, gen), used in have.items():
+        assert used >= 0
+        nominal, borrow_limit, cohort = limits[queue][gen]
+        if borrow_limit is not None:
+            assert used <= nominal + borrow_limit
+        if cohort:
+            members = [q for q in limits if limits[q][gen][2] == cohort]
+            assert sum(ledger.usage(m, gen) for m in members) <= sum(
+                limits[m][gen][0] for m in members
+            )
+
+
+class TestLedgerProperties:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_interleavings_never_leak_or_double_free(self, seed):
+        rng = random.Random(seed)
+        ledger = QuotaLedger()
+        limits = {
+            "a": {"v5e": (16, None, "c"), "v5p": (8, None, "c")},
+            "b": {"v5e": (16, 8, "c"), "v5p": (0, 8, "c")},
+            "solo": {"v5e": (32, None, ""), "v5p": (0, None, "")},
+        }
+        for name, gens in limits.items():
+            ledger.set_queue(
+                name,
+                cohort=gens["v5e"][2],
+                quotas={
+                    gen: QueueQuota(nominal, borrow)
+                    for gen, (nominal, borrow, _) in gens.items()
+                },
+            )
+        keys = [("default", f"job-{i}") for i in range(12)]
+        clock = [0.0]
+        for _ in range(400):
+            op = rng.choice(["reserve", "release", "release", "reclaim",
+                             "reconcile"])
+            if op == "reserve":
+                clock[0] += 1
+                try:
+                    ledger.reserve(
+                        rng.choice(keys), rng.choice(list(limits)),
+                        rng.choice(["v5e", "v5p"]),
+                        rng.choice([4, 8, 16]), admitted_at=clock[0],
+                    )
+                except RuntimeError as exc:
+                    assert "insufficient quota" in str(exc)
+            elif op == "release":
+                key = rng.choice(keys)
+                before = ledger.charges()
+                ledger.release(key)
+                ledger.release(key)  # double-free must be a no-op
+                after = ledger.charges()
+                assert set(before) - set(after) <= {key}
+            elif op == "reclaim":
+                lender = rng.choice(list(limits))
+                victims = ledger.reclaim_candidates(
+                    lender, rng.choice(["v5e", "v5p"]), rng.choice([8, 16])
+                )
+                for victim in victims or []:
+                    ledger.release(victim)
+            else:
+                ledger.reconcile(list(ledger.charges().items()))
+            ledger_invariants(ledger, limits)
+
+    def test_reserve_replaces_prior_charge(self):
+        ledger = QuotaLedger()
+        ledger.set_queue("a", quotas={"v5e": QueueQuota(16)})
+        key = ("default", "job")
+        ledger.reserve(key, "a", "v5e", 16)
+        # Re-reserving the same key must not stack usage.
+        ledger.reserve(key, "a", "v5e", 8)
+        assert ledger.usage("a", "v5e") == 8
+
+    def test_remove_queue_releases_charges(self):
+        ledger = QuotaLedger()
+        ledger.set_queue("a", quotas={"v5e": QueueQuota(16)})
+        ledger.reserve(("default", "job"), "a", "v5e", 16)
+        ledger.remove_queue("a")
+        assert ledger.charges() == {}
+        assert ledger.usage("a", "v5e") == 0
