@@ -1016,6 +1016,75 @@ def replica_spec_schema(role: str) -> dict:
     }
 
 
+def pod_failure_policy_schema() -> dict:
+    """batch/v1 PodFailurePolicy analog: ordered rules classifying worker
+    failures by container exit code or pod condition/reason."""
+    return {
+        "type": "object",
+        "required": ["rules"],
+        "description": (
+            "Ordered failure-classification rules; the first rule matching "
+            "a failed worker pod decides its fate. Ignore replaces the pod "
+            "without charging backoffLimit, Restart replaces and charges, "
+            "FailJob fails the job with reason PodFailurePolicy."
+        ),
+        "properties": {
+            "rules": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "required": ["action"],
+                    "properties": {
+                        "action": _str(
+                            "What to do with a matching failed pod.",
+                            enum=[
+                                types.POD_FAILURE_POLICY_ACTION_IGNORE,
+                                types.POD_FAILURE_POLICY_ACTION_RESTART,
+                                types.POD_FAILURE_POLICY_ACTION_FAIL_JOB,
+                            ],
+                        ),
+                        "onExitCodes": {
+                            "type": "object",
+                            "required": ["operator", "values"],
+                            "properties": {
+                                "containerName": _str(
+                                    "Restrict matching to this container."
+                                ),
+                                "operator": _str(
+                                    enum=[
+                                        types.POD_FAILURE_POLICY_OP_IN,
+                                        types.POD_FAILURE_POLICY_OP_NOT_IN,
+                                    ]
+                                ),
+                                "values": {
+                                    "type": "array",
+                                    "items": _int(minimum=0, maximum=255),
+                                },
+                            },
+                        },
+                        "onPodConditions": {
+                            "type": "array",
+                            "items": {
+                                "type": "object",
+                                "properties": {
+                                    "type": _str("Pod condition type to match."),
+                                    "status": _str(
+                                        enum=["True", "False", "Unknown"]
+                                    ),
+                                    "reason": _str(
+                                        "Match pod status.reason (e.g. Evicted, "
+                                        "NodeLost) — TPU extension."
+                                    ),
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    }
+
+
 def job_spec_schema() -> dict:
     return {
         "type": "object",
@@ -1086,6 +1155,7 @@ def job_spec_schema() -> dict:
                             "priorityClass": _str(),
                         },
                     },
+                    "podFailurePolicy": pod_failure_policy_schema(),
                 },
             },
             "tpuReplicaSpecs": {

@@ -65,6 +65,189 @@ JOB_FAILED = "Failed"
 JOB_QUOTA_RESERVED = "QuotaReserved"
 JOB_QUEUE_NOT_FOUND = "QueueNotFound"
 
+# podFailurePolicy actions (batch/v1 PodFailurePolicyAction analog, with
+# ``Restart`` standing in for batch's ``Count`` — the TPU operator
+# replaces failed workers rather than tallying them).
+POD_FAILURE_POLICY_ACTION_IGNORE = "Ignore"
+POD_FAILURE_POLICY_ACTION_RESTART = "Restart"
+POD_FAILURE_POLICY_ACTION_FAIL_JOB = "FailJob"
+# onExitCodes operators (batch/v1 PodFailurePolicyOnExitCodesOperator).
+POD_FAILURE_POLICY_OP_IN = "In"
+POD_FAILURE_POLICY_OP_NOT_IN = "NotIn"
+# Condition reason when a FailJob rule terminates the job.
+JOB_POD_FAILURE_POLICY_REASON = "PodFailurePolicy"
+
+
+@dataclass
+class PodFailurePolicyOnExitCodes:
+    """Exit-code requirement (batch/v1 PodFailurePolicyOnExitCodesRequirement).
+
+    Matches when any terminated container (optionally restricted to
+    ``container_name``) exited non-zero with a code In/NotIn ``values``.
+    Exit code 0 never matches — success is not a failure class.
+    """
+
+    operator: str = POD_FAILURE_POLICY_OP_IN
+    values: list[int] = field(default_factory=list)
+    container_name: str = ""
+
+    def matches(self, pod: dict) -> bool:
+        codes = _terminated_exit_codes(pod, self.container_name)
+        if self.operator == POD_FAILURE_POLICY_OP_NOT_IN:
+            return any(c != 0 and c not in self.values for c in codes)
+        return any(c != 0 and c in self.values for c in codes)
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {"operator": self.operator, "values": list(self.values)}
+        if self.container_name:
+            d["containerName"] = self.container_name
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "PodFailurePolicyOnExitCodes":
+        d = d or {}
+        return cls(
+            operator=d.get("operator", POD_FAILURE_POLICY_OP_IN),
+            values=[int(v) for v in d.get("values") or []],
+            container_name=d.get("containerName", ""),
+        )
+
+
+@dataclass
+class PodFailurePolicyOnPodCondition:
+    """Pod-condition requirement (batch/v1 ...OnPodConditionsPattern).
+
+    ``reason`` is a TPU extension: the in-process kubelet reports failure
+    classes (Evicted, NodeLost, Error) through ``status.reason`` rather
+    than synthetic conditions, so rules may match on it directly.
+    """
+
+    type: str = ""
+    status: str = "True"
+    reason: str = ""
+
+    def matches(self, pod: dict) -> bool:
+        status = pod.get("status") or {}
+        if self.reason and status.get("reason") != self.reason:
+            return False
+        if self.type:
+            for cond in status.get("conditions") or []:
+                if cond.get("type") == self.type and cond.get("status") == self.status:
+                    break
+            else:
+                return False
+        return bool(self.reason or self.type)
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {}
+        if self.type:
+            d["type"] = self.type
+            d["status"] = self.status
+        if self.reason:
+            d["reason"] = self.reason
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "PodFailurePolicyOnPodCondition":
+        d = d or {}
+        return cls(
+            type=d.get("type", ""),
+            status=d.get("status", "True"),
+            reason=d.get("reason", ""),
+        )
+
+
+@dataclass
+class PodFailurePolicyRule:
+    """One ordered rule: first match wins (batch/v1 PodFailurePolicyRule).
+
+    Exactly one of ``on_exit_codes`` / ``on_pod_conditions`` must be set
+    (validation enforces this); a rule with conditions matches when *any*
+    listed pattern matches.
+    """
+
+    action: str = ""
+    on_exit_codes: Optional[PodFailurePolicyOnExitCodes] = None
+    on_pod_conditions: list[PodFailurePolicyOnPodCondition] = field(
+        default_factory=list
+    )
+
+    def matches(self, pod: dict) -> bool:
+        if self.on_exit_codes is not None:
+            return self.on_exit_codes.matches(pod)
+        if self.on_pod_conditions:
+            return any(p.matches(pod) for p in self.on_pod_conditions)
+        return False
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {"action": self.action}
+        if self.on_exit_codes is not None:
+            d["onExitCodes"] = self.on_exit_codes.to_dict()
+        if self.on_pod_conditions:
+            d["onPodConditions"] = [p.to_dict() for p in self.on_pod_conditions]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "PodFailurePolicyRule":
+        d = d or {}
+        return cls(
+            action=d.get("action", ""),
+            on_exit_codes=(
+                PodFailurePolicyOnExitCodes.from_dict(d["onExitCodes"])
+                if "onExitCodes" in d
+                else None
+            ),
+            on_pod_conditions=[
+                PodFailurePolicyOnPodCondition.from_dict(p)
+                for p in d.get("onPodConditions") or []
+            ],
+        )
+
+
+@dataclass
+class PodFailurePolicy:
+    """Ordered failure-classification rules (batch/v1 PodFailurePolicy).
+
+    The controller consults :meth:`match` when a worker pod fails:
+    ``Ignore`` replaces the pod without charging ``backoffLimit`` (TPU
+    preemptions are not the job's fault), ``Restart`` replaces it and
+    charges the budget, ``FailJob`` fails the whole job immediately with
+    condition reason ``PodFailurePolicy`` (assertion-style exit codes
+    should not burn through retries).
+    """
+
+    rules: list[PodFailurePolicyRule] = field(default_factory=list)
+
+    def match(self, pod: dict) -> Optional[PodFailurePolicyRule]:
+        for rule in self.rules:
+            if rule.matches(pod):
+                return rule
+        return None
+
+    def to_dict(self) -> dict:
+        return {"rules": [r.to_dict() for r in self.rules]}
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "PodFailurePolicy":
+        d = d or {}
+        return cls(
+            rules=[PodFailurePolicyRule.from_dict(r) for r in d.get("rules") or []]
+        )
+
+
+def _terminated_exit_codes(pod: dict, container_name: str = "") -> list[int]:
+    """Exit codes of terminated containers, from containerStatuses."""
+    codes: list[int] = []
+    status = pod.get("status") or {}
+    for cs in status.get("containerStatuses") or []:
+        if container_name and cs.get("name") != container_name:
+            continue
+        terminated = (cs.get("state") or {}).get("terminated") or {}
+        code = terminated.get("exitCode")
+        if code is not None:
+            codes.append(int(code))
+    return codes
+
 
 @dataclass
 class SchedulingPolicy:
@@ -110,6 +293,7 @@ class RunPolicy:
     backoff_limit: Optional[int] = None
     scheduling_policy: Optional[SchedulingPolicy] = None
     suspend: Optional[bool] = None
+    pod_failure_policy: Optional[PodFailurePolicy] = None
 
     def to_dict(self) -> dict:
         d: dict[str, Any] = {}
@@ -125,6 +309,8 @@ class RunPolicy:
             d["schedulingPolicy"] = self.scheduling_policy.to_dict()
         if self.suspend is not None:
             d["suspend"] = self.suspend
+        if self.pod_failure_policy is not None:
+            d["podFailurePolicy"] = self.pod_failure_policy.to_dict()
         return d
 
     @classmethod
@@ -141,6 +327,11 @@ class RunPolicy:
                 else None
             ),
             suspend=d.get("suspend"),
+            pod_failure_policy=(
+                PodFailurePolicy.from_dict(d["podFailurePolicy"])
+                if "podFailurePolicy" in d
+                else None
+            ),
         )
 
 

@@ -31,6 +31,11 @@ from .v2beta1.types import (
     CLEAN_POD_POLICY_ALL,
     CLEAN_POD_POLICY_NONE,
     CLEAN_POD_POLICY_RUNNING,
+    POD_FAILURE_POLICY_ACTION_FAIL_JOB,
+    POD_FAILURE_POLICY_ACTION_IGNORE,
+    POD_FAILURE_POLICY_ACTION_RESTART,
+    POD_FAILURE_POLICY_OP_IN,
+    POD_FAILURE_POLICY_OP_NOT_IN,
     REPLICA_TYPE_LAUNCHER,
     REPLICA_TYPE_WORKER,
     RESTART_POLICY_NEVER,
@@ -47,6 +52,15 @@ VALID_CLEAN_POD_POLICIES = (
     CLEAN_POD_POLICY_ALL,
 )
 VALID_RESTART_POLICIES = (RESTART_POLICY_NEVER, RESTART_POLICY_ON_FAILURE)
+VALID_POD_FAILURE_POLICY_ACTIONS = (
+    POD_FAILURE_POLICY_ACTION_IGNORE,
+    POD_FAILURE_POLICY_ACTION_RESTART,
+    POD_FAILURE_POLICY_ACTION_FAIL_JOB,
+)
+VALID_POD_FAILURE_POLICY_OPERATORS = (
+    POD_FAILURE_POLICY_OP_IN,
+    POD_FAILURE_POLICY_OP_NOT_IN,
+)
 
 
 @dataclass(frozen=True)
@@ -200,6 +214,76 @@ def _validate_run_policy(policy: RunPolicy, path: str) -> list[FieldError]:
             errs.append(
                 invalid(f"{path}.schedulingPolicy.queue", sp.queue, detail)
             )
+    if policy.pod_failure_policy is not None:
+        errs += _validate_pod_failure_policy(
+            policy.pod_failure_policy, f"{path}.podFailurePolicy"
+        )
+    return errs
+
+
+def _validate_pod_failure_policy(policy, path: str) -> list[FieldError]:
+    # batch/v1 validation analog: every rule names a supported action and
+    # exactly one requirement; In-operator exit codes must be non-zero
+    # (exit 0 is success, not a failure class).
+    errs: list[FieldError] = []
+    if not policy.rules:
+        errs.append(required(f"{path}.rules", "must declare at least one rule"))
+    for i, rule in enumerate(policy.rules):
+        rpath = f"{path}.rules[{i}]"
+        if rule.action not in VALID_POD_FAILURE_POLICY_ACTIONS:
+            errs.append(
+                not_supported(
+                    f"{rpath}.action", rule.action, VALID_POD_FAILURE_POLICY_ACTIONS
+                )
+            )
+        has_codes = rule.on_exit_codes is not None
+        has_conds = bool(rule.on_pod_conditions)
+        if has_codes == has_conds:
+            errs.append(
+                invalid(
+                    rpath,
+                    rule.to_dict(),
+                    "must specify exactly one of onExitCodes, onPodConditions",
+                )
+            )
+        if has_codes:
+            oec = rule.on_exit_codes
+            if oec.operator not in VALID_POD_FAILURE_POLICY_OPERATORS:
+                errs.append(
+                    not_supported(
+                        f"{rpath}.onExitCodes.operator",
+                        oec.operator,
+                        VALID_POD_FAILURE_POLICY_OPERATORS,
+                    )
+                )
+            if not oec.values:
+                errs.append(
+                    required(f"{rpath}.onExitCodes.values", "must list exit codes")
+                )
+            elif oec.operator == POD_FAILURE_POLICY_OP_IN and 0 in oec.values:
+                errs.append(
+                    invalid(
+                        f"{rpath}.onExitCodes.values",
+                        oec.values,
+                        "must not contain 0 for the In operator",
+                    )
+                )
+        for j, pat in enumerate(rule.on_pod_conditions):
+            if not pat.type and not pat.reason:
+                errs.append(
+                    required(
+                        f"{rpath}.onPodConditions[{j}]",
+                        "must set type and/or reason",
+                    )
+                )
+            if pat.type and pat.status not in ("True", "False", "Unknown"):
+                errs.append(
+                    not_supported(
+                        f"{rpath}.onPodConditions[{j}].status",
+                        pat.status,
+                        ("True", "False", "Unknown"),
+                    )
+                )
     return errs
 
 

@@ -1,0 +1,53 @@
+"""Seeded, deterministic fault injection for the operator stack.
+
+The harness wraps the two process-local substrates everything else runs
+on — ``runtime.apiserver.InMemoryAPIServer`` and
+``runtime.podrunner.LocalPodRunner`` — behind declarative fault policies
+driven by one ``random.Random(seed)``, so any chaos run is replayable
+from its seed.  See docs/failure-handling.md for usage.
+"""
+
+from .apiserver import ChaoticAPIServer, ChaoticWatch
+from .engine import (
+    CONFLICT,
+    NODE_DEATH,
+    POD_KILL,
+    SERVER_ERROR,
+    TIMEOUT,
+    WATCH_DELAY,
+    WATCH_DROP,
+    WATCH_GONE,
+    ChaosEngine,
+    ChaosEvent,
+)
+from .podchaos import PodKiller
+from .policy import (
+    READ_VERBS,
+    WRITE_VERBS,
+    ChaosPolicy,
+    PodChaos,
+    VerbFaults,
+    WatchFaults,
+)
+
+__all__ = [
+    "CONFLICT",
+    "NODE_DEATH",
+    "POD_KILL",
+    "READ_VERBS",
+    "SERVER_ERROR",
+    "TIMEOUT",
+    "WATCH_DELAY",
+    "WATCH_DROP",
+    "WATCH_GONE",
+    "WRITE_VERBS",
+    "ChaosEngine",
+    "ChaosEvent",
+    "ChaosPolicy",
+    "ChaoticAPIServer",
+    "ChaoticWatch",
+    "PodChaos",
+    "PodKiller",
+    "VerbFaults",
+    "WatchFaults",
+]

@@ -1,0 +1,174 @@
+"""Seeded chaos engine: one RNG, one ordered event log, one decision
+point per fault surface.
+
+Determinism contract: every fault decision consumes exactly one draw
+from a single ``random.Random(seed)``, and every *injected* fault is
+appended to an ordered event log.  Given the same policy and the same
+sequence of decision calls (e.g. a single-threaded, manually-pumped
+stack), the same seed therefore reproduces the identical fault sequence
+— the property the soak test asserts, and the property that makes a
+failing chaos run replayable from its seed alone.  Under free-running
+threads the per-call *order* is up to the OS scheduler, but the invariant
+suite (convergence, no leaks, ledger balance) holds for every
+interleaving.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from ..runtime.apiserver import (
+    ApiError,
+    ConflictError,
+    ServerError,
+    ServerTimeoutError,
+)
+from ..utils.metrics import Registry, new_counter
+from .policy import ChaosPolicy, PodChaos
+
+# Fault kinds (event-log / metric label vocabulary).
+CONFLICT = "conflict"
+SERVER_ERROR = "server_error"
+TIMEOUT = "timeout"
+WATCH_DROP = "watch_drop"
+WATCH_DELAY = "watch_delay"
+WATCH_GONE = "watch_gone"
+POD_KILL = "pod_kill"
+NODE_DEATH = "node_death"
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    seq: int
+    kind: str
+    target: str  # e.g. "update pods/ns/train-worker-0"
+    detail: str = ""
+
+
+class ChaosEngine:
+    """Interprets a ChaosPolicy with a seeded RNG and logs what it did."""
+
+    def __init__(
+        self,
+        policy: ChaosPolicy,
+        registry: Optional[Registry] = None,
+    ):
+        self.policy = policy
+        self.seed = policy.seed
+        self._rng = random.Random(policy.seed)
+        self._lock = threading.Lock()
+        self._events: list[ChaosEvent] = []
+        self._kill_counts: dict[int, int] = {}
+        self.faults_total = new_counter(
+            "tpu_operator_chaos_faults_injected_total",
+            "Faults injected by the chaos engine, by kind.",
+            ("kind",),
+            registry=registry,
+        )
+        self.pod_kills_total = new_counter(
+            "tpu_operator_chaos_pod_kills_total",
+            "Pods killed by the chaos engine, by mode (pod_kill|node_death).",
+            ("mode",),
+            registry=registry,
+        )
+
+    # -- event log -------------------------------------------------------
+
+    def record(self, kind: str, target: str, detail: str = "") -> ChaosEvent:
+        with self._lock:
+            event = ChaosEvent(len(self._events), kind, target, detail)
+            self._events.append(event)
+        self.faults_total.inc(1.0, kind)
+        return event
+
+    def events(self) -> tuple[ChaosEvent, ...]:
+        with self._lock:
+            return tuple(self._events)
+
+    def timeline(self) -> list[tuple[str, str, str]]:
+        """(kind, target, detail) triples in injection order — the stable
+        comparison form for same-seed replay assertions."""
+        return [(e.kind, e.target, e.detail) for e in self.events()]
+
+    def roll(self) -> float:
+        with self._lock:
+            return self._rng.random()
+
+    # -- apiserver verbs -------------------------------------------------
+
+    def fault_for(
+        self, verb: str, resource: str, name: str
+    ) -> Optional[ApiError]:
+        """Decide one verb call's fate; return the error to raise (already
+        recorded) or None.  Consumes exactly one draw when a policy
+        matches, zero otherwise."""
+        policy = self.policy.verb_policy(verb, resource)
+        if policy is None or policy.total_rate <= 0.0:
+            return None
+        r = self.roll()
+        target = f"{verb} {resource}/{name}"
+        if r < policy.conflict_rate:
+            self.record(CONFLICT, target)
+            return ConflictError(resource, name, "chaos: injected conflict")
+        r -= policy.conflict_rate
+        if r < policy.server_error_rate:
+            self.record(SERVER_ERROR, target)
+            return ServerError(resource, name, "chaos: injected 500")
+        r -= policy.server_error_rate
+        if r < policy.timeout_rate:
+            self.record(TIMEOUT, target)
+            return ServerTimeoutError(resource, name, "chaos: injected timeout")
+        return None
+
+    # -- watch streams ---------------------------------------------------
+
+    def watch_fault(self, resource: str, key: str) -> Optional[str]:
+        """Decide one watch event's fate: WATCH_DROP, WATCH_DELAY,
+        WATCH_GONE, or None (deliver normally)."""
+        watch = self.policy.watch
+        if watch is None or not watch.applies(resource):
+            return None
+        r = self.roll()
+        target = f"watch {resource}/{key}"
+        if r < watch.gone_rate:
+            self.record(WATCH_GONE, target)
+            return WATCH_GONE
+        r -= watch.gone_rate
+        if r < watch.drop_rate:
+            self.record(WATCH_DROP, target)
+            return WATCH_DROP
+        r -= watch.drop_rate
+        if r < watch.delay_rate:
+            self.record(WATCH_DELAY, target, f"rounds={watch.delay_rounds}")
+            return WATCH_DELAY
+        return None
+
+    # -- pod / node chaos ------------------------------------------------
+
+    def pod_fault(self, policy_index: int, policy: PodChaos) -> Optional[str]:
+        """Decide one (policy, pod, tick)'s fate: POD_KILL, NODE_DEATH, or
+        None.  A confirmed kill must be reported via confirm_kill so the
+        max_kills budget counts only kills that actually landed."""
+        if policy.kill_rate <= 0.0 and policy.node_death_rate <= 0.0:
+            return None
+        if policy.max_kills:
+            with self._lock:
+                if self._kill_counts.get(policy_index, 0) >= policy.max_kills:
+                    return None
+        r = self.roll()
+        if r < policy.kill_rate:
+            return POD_KILL
+        if r < policy.kill_rate + policy.node_death_rate:
+            return NODE_DEATH
+        return None
+
+    def confirm_kill(self, policy_index: int, mode: str, key: str) -> None:
+        with self._lock:
+            self._kill_counts[policy_index] = (
+                self._kill_counts.get(policy_index, 0) + 1
+            )
+        self.record(mode, f"pod {key}")
+        self.pod_kills_total.inc(1.0, mode)

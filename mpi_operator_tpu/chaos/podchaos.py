@@ -1,0 +1,84 @@
+"""Pod- and node-level chaos against the LocalPodRunner.
+
+``PodKiller.tick()`` is one chaos round: every running pod matching an
+active ``PodChaos`` policy gets one seeded draw deciding whether it is
+SIGKILLed (preemption signature, exit code 137) or loses its node
+(phase=Failed with ``status.reason=NodeLost``, no exit code).  The caller
+paces ticks — a thread in a live soak, explicit calls in a deterministic
+replay.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..api.v2beta1.constants import JOB_ROLE_LABEL
+from ..runtime.apiserver import InMemoryAPIServer
+from .engine import NODE_DEATH, POD_KILL, ChaosEngine
+
+
+class PodKiller:
+    def __init__(self, engine: ChaosEngine, api: InMemoryAPIServer, runner):
+        # List against the raw server: the killer is the chaos, it should
+        # not itself be a victim of injected read faults.
+        self._engine = engine
+        self._api = getattr(api, "inner", api)
+        self._runner = runner
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def tick(self) -> int:
+        """One chaos round; returns the number of kills that landed."""
+        kills = 0
+        for index, policy in enumerate(self._engine.policy.pods):
+            if policy.kill_rate <= 0.0 and policy.node_death_rate <= 0.0:
+                continue
+            pods = self._api.list("pods", policy.namespace or None)
+            for pod in pods:
+                if (pod.get("status") or {}).get("phase") != "Running":
+                    continue
+                meta = pod.get("metadata") or {}
+                labels = meta.get("labels") or {}
+                role = labels.get(JOB_ROLE_LABEL, "")
+                if policy.roles and role not in policy.roles:
+                    continue
+                mode = self._engine.pod_fault(index, policy)
+                if mode is None:
+                    continue
+                namespace = meta.get("namespace", "")
+                name = meta.get("name", "")
+                if mode == POD_KILL:
+                    landed = self._runner.kill_pod(namespace, name)
+                elif mode == NODE_DEATH:
+                    landed = self._runner.fail_node(namespace, name)
+                else:  # pragma: no cover - engine vocabulary is closed
+                    landed = False
+                if landed:
+                    self._engine.confirm_kill(
+                        index, mode, f"{namespace}/{name}"
+                    )
+                    kills += 1
+        return kills
+
+    # -- background pacing (live soaks) ---------------------------------
+
+    def start(self, interval: float = 0.05) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, args=(interval,), daemon=True,
+            name="chaos-podkiller",
+        )
+        self._thread.start()
+
+    def _loop(self, interval: float) -> None:
+        while not self._stop.is_set():
+            self.tick()
+            self._stop.wait(interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

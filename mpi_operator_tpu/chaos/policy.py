@@ -1,0 +1,135 @@
+"""Fault policies for the chaos harness.
+
+Everything here is declarative and immutable: a ``ChaosPolicy`` is a full
+description of one chaos run (which verbs flake, how the watch streams
+misbehave, which pods die) plus the seed that makes the run replayable.
+The engine (``chaos/engine.py``) interprets the policy; the wrappers
+(``chaos/apiserver.py``, ``chaos/podchaos.py``) apply it.
+
+Reference analogs: kube-apiserver's ``APIServerTracing`` fault-injection
+test shims and chaos-mesh's PodChaos/NetworkChaos CRDs, collapsed to the
+three fault surfaces this operator actually exercises — apiserver verbs,
+watch streams, and pod/node lifetime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..api.v2beta1.constants import ROLE_LAUNCHER, ROLE_WORKER
+
+# Verbs that mutate state; fault injection on these models write races
+# (conflicts) and apiserver hiccups (500s/timeouts).
+WRITE_VERBS = ("create", "update", "update_status", "delete")
+READ_VERBS = ("get", "list")
+
+
+def _check_rate(name: str, value: float) -> None:
+    if not 0.0 <= value <= 1.0:
+        raise ValueError(f"{name} must be in [0, 1], got {value!r}")
+
+
+@dataclass(frozen=True)
+class VerbFaults:
+    """Per-call fault rates for apiserver verbs.
+
+    Exactly one uniform draw decides each call's fate, partitioned
+    conflict → server error → timeout, so rates are mutually exclusive
+    and their sum is the per-call fault probability.
+    """
+
+    conflict_rate: float = 0.0
+    server_error_rate: float = 0.0
+    timeout_rate: float = 0.0
+    verbs: tuple[str, ...] = WRITE_VERBS
+    resources: tuple[str, ...] = ()  # () = every resource
+
+    def __post_init__(self) -> None:
+        _check_rate("conflict_rate", self.conflict_rate)
+        _check_rate("server_error_rate", self.server_error_rate)
+        _check_rate("timeout_rate", self.timeout_rate)
+        if self.total_rate > 1.0:
+            raise ValueError(
+                f"fault rates sum to {self.total_rate}, must be <= 1"
+            )
+
+    @property
+    def total_rate(self) -> float:
+        return self.conflict_rate + self.server_error_rate + self.timeout_rate
+
+    def applies(self, verb: str, resource: str) -> bool:
+        if verb not in self.verbs:
+            return False
+        return not self.resources or resource in self.resources
+
+
+@dataclass(frozen=True)
+class WatchFaults:
+    """Per-event fault rates for watch streams.
+
+    ``drop`` loses the event (a lossy stream the informer can only heal
+    by resync), ``delay`` re-delivers it ``delay_rounds`` drains later
+    (out-of-order delivery), ``gone`` compacts the stream — everything
+    buffered is lost and the drain raises 410 Gone, forcing a relist.
+    """
+
+    drop_rate: float = 0.0
+    delay_rate: float = 0.0
+    gone_rate: float = 0.0
+    delay_rounds: int = 2
+    resources: tuple[str, ...] = ()  # () = every resource
+
+    def __post_init__(self) -> None:
+        _check_rate("drop_rate", self.drop_rate)
+        _check_rate("delay_rate", self.delay_rate)
+        _check_rate("gone_rate", self.gone_rate)
+        if self.drop_rate + self.delay_rate + self.gone_rate > 1.0:
+            raise ValueError("watch fault rates must sum to <= 1")
+        if self.delay_rounds < 1:
+            raise ValueError("delay_rounds must be >= 1")
+
+    def applies(self, resource: str) -> bool:
+        return not self.resources or resource in self.resources
+
+
+@dataclass(frozen=True)
+class PodChaos:
+    """Random pod kills and node deaths.
+
+    ``kill_rate`` SIGKILLs the pod's process — the reaper classifies it
+    like any crash and surfaces exit code 137, the TPU-preemption
+    signature a ``podFailurePolicy`` rule can match.  ``node_death_rate``
+    rips the pod out from under the runner and flips its phase to
+    ``Failed`` with ``status.reason=NodeLost`` (no exit code), the shape
+    an ``onPodConditions``-style reason rule matches.
+    """
+
+    kill_rate: float = 0.0
+    node_death_rate: float = 0.0
+    roles: tuple[str, ...] = (ROLE_WORKER, ROLE_LAUNCHER)
+    namespace: str = ""  # "" = every namespace
+    max_kills: int = 0  # 0 = unlimited
+
+    def __post_init__(self) -> None:
+        _check_rate("kill_rate", self.kill_rate)
+        _check_rate("node_death_rate", self.node_death_rate)
+        if self.kill_rate + self.node_death_rate > 1.0:
+            raise ValueError("pod chaos rates must sum to <= 1")
+
+
+@dataclass(frozen=True)
+class ChaosPolicy:
+    """One replayable chaos run: seed + the active fault policies."""
+
+    seed: int = 0
+    verbs: tuple[VerbFaults, ...] = ()
+    watch: Optional[WatchFaults] = None
+    pods: tuple[PodChaos, ...] = ()
+
+    def verb_policy(self, verb: str, resource: str) -> Optional[VerbFaults]:
+        """First policy matching (verb, resource); None = no faults."""
+        for policy in self.verbs:
+            if policy.applies(verb, resource):
+                return policy
+        return None

@@ -31,18 +31,23 @@ from ..api.v2beta1.types import (
     API_VERSION,
     JOB_CREATED,
     JOB_FAILED,
+    JOB_POD_FAILURE_POLICY_REASON,
     JOB_RESTARTING,
     JOB_RUNNING,
     JOB_SCHEDULED,
     JOB_SUCCEEDED,
     JOB_SUSPENDED,
     KIND,
+    POD_FAILURE_POLICY_ACTION_FAIL_JOB,
+    POD_FAILURE_POLICY_ACTION_IGNORE,
     REPLICA_TYPE_LAUNCHER,
     REPLICA_TYPE_WORKER,
     RESTART_POLICY_ON_FAILURE,
+    PodFailurePolicyRule,
     ReplicaStatus,
     TPUJob,
 )
+from ..runtime import retry
 from ..runtime.apiserver import (
     AlreadyExistsError,
     ConflictError,
@@ -292,7 +297,7 @@ class TPUJobController:
         def pump_loop():
             while not stop.is_set():
                 if self.factory.pump_all() == 0:
-                    time.sleep(0.005)
+                    retry.sleep(0.005)
 
         threads = [threading.Thread(target=pump_loop, daemon=True)]
         for _ in range(threadiness):
@@ -635,15 +640,12 @@ class TPUJobController:
         if existing.get("data") != desired.data:
             updated = KubeObject.from_dict(existing)
             updated.data = desired.data
-            try:
-                return self.kube.configmaps(job.namespace).update(updated).to_dict()
-            except ConflictError:
+            def rediff_and_write():
                 # Cached resourceVersion lagged a write this controller
                 # already made (discover-hosts updates happen every sync):
-                # re-read, re-diff, one retry. A further race waits for
-                # the next sync. The re-read object may be a same-named
-                # foreign recreate — the adoption check must run again
-                # before writing over it.
+                # re-read, re-diff, write. The re-read object may be a
+                # same-named foreign recreate — the adoption check must
+                # run again before writing over it.
                 fresh = self._read_through_adopt(
                     self.kube.configmaps(job.namespace), job, desired.name,
                     recreate=lambda: self.kube.configmaps(job.namespace)
@@ -654,6 +656,13 @@ class TPUJobController:
                 refreshed = KubeObject.from_dict(fresh)
                 refreshed.data = desired.data
                 return self.kube.configmaps(job.namespace).update(refreshed).to_dict()
+
+            try:
+                return self.kube.configmaps(job.namespace).update(updated).to_dict()
+            except ConflictError:
+                # A persistent race past the backoff waits for the next
+                # sync (the workqueue requeues on error).
+                return retry.retry_on_conflict(rediff_and_write, retry.DEFAULT_RETRY)
         return existing
 
     def _get_or_create_pod_group(self, job: TPUJob, min_member: int) -> dict:
@@ -761,7 +770,9 @@ class TPUJobController:
             pod = self.pod_informer.lister.get(job.namespace, name)
             if pod is not None and is_controlled_by(pod, job):
                 reason = self._elastic_restart_reason(
-                    job, pod, replicas, allow_failure_restart=may_restart_failed()
+                    job, pod, replicas,
+                    allow_failure_restart=may_restart_failed(),
+                    rejoinable=not any_succeeded,
                 )
                 if reason is not None:
                     # The cache can lag a restart this controller just did
@@ -776,6 +787,7 @@ class TPUJobController:
                         self._elastic_restart_reason(
                             job, fresh, replicas,
                             allow_failure_restart=may_restart_failed(),
+                            rejoinable=not any_succeeded,
                         )
                         if fresh is not None
                         else None
@@ -811,6 +823,7 @@ class TPUJobController:
                     reason = self._elastic_restart_reason(
                         job, pod, replicas,
                         allow_failure_restart=may_restart_failed(),
+                        rejoinable=not any_succeeded,
                     )
                     if reason is not None:
                         delete_for_restart(name, reason)
@@ -852,12 +865,28 @@ class TPUJobController:
             )
         return out
 
+    def _pod_failure_rule(
+        self, job: TPUJob, pod: dict
+    ) -> Optional[PodFailurePolicyRule]:
+        """First podFailurePolicy rule matching a failed pod, or None."""
+        policy = job.spec.run_policy.pod_failure_policy
+        if policy is None:
+            return None
+        return policy.match(pod)
+
     def _elastic_restart_reason(
-        self, job: TPUJob, pod: dict, replicas: int, *, allow_failure_restart: bool
+        self,
+        job: TPUJob,
+        pod: dict,
+        replicas: int,
+        *,
+        allow_failure_restart: bool,
+        rejoinable: bool = True,
     ) -> Optional[str]:
         """Why this worker pod must be replaced, or None to keep it.
         Failure-replacement reasons always start with "failed" (they count
-        against runPolicy.backoffLimit); stale-stamp reasons do not.
+        against runPolicy.backoffLimit); stale-stamp and policy-Ignore
+        reasons do not.
 
         Two triggers (BASELINE.md milestone 5, SURVEY.md §3.4 analog):
         - stale world size: the pod's rendezvous env was rendered for a
@@ -867,6 +896,14 @@ class TPUJobController:
           slice hosts come back by pod replacement (kubelet only restarts
           containers in-place; a deleted/failed pod needs the controller)
           — gated by ``allow_failure_restart`` (budget + rejoinability).
+
+        ``runPolicy.podFailurePolicy`` refines the failure branch: an
+        ``Ignore`` match (TPU preemption signature) replaces the pod
+        *without* the "failed" prefix, so the restart never charges
+        ``backoffLimit`` (only ``rejoinable`` gates it); a ``FailJob``
+        match keeps the pod so ``_update_job_status`` fails the job; a
+        ``Restart`` match behaves like the default failure path but also
+        applies under ``restartPolicy: Never``.
         """
         worker_spec = job.spec.replica_specs.get(REPLICA_TYPE_WORKER)
         restart_policy = worker_spec.restart_policy if worker_spec else ""
@@ -875,12 +912,24 @@ class TPUJobController:
         # consumes runPolicy.backoffLimit) — otherwise repeated resizes
         # during a crash loop would replace workers forever without the
         # budget ever bounding it.
-        if restart_policy == RESTART_POLICY_ON_FAILURE and \
-                _pod_phase(pod) == POD_FAILED:
-            if not allow_failure_restart:
-                return None  # budget exhausted; never launder via staleness
-            reason = (pod.get("status") or {}).get("reason", "")
-            return f"failed{f' ({reason})' if reason else ''}"
+        if _pod_phase(pod) == POD_FAILED:
+            rule = self._pod_failure_rule(job, pod)
+            pod_reason = (pod.get("status") or {}).get("reason", "")
+            if rule is not None:
+                if rule.action == POD_FAILURE_POLICY_ACTION_FAIL_JOB:
+                    return None  # keep the evidence; the job fails this sync
+                if rule.action == POD_FAILURE_POLICY_ACTION_IGNORE:
+                    if not rejoinable:
+                        return None
+                    return f"ignored by podFailurePolicy ({pod_reason or 'exit code'})"
+                # Restart: charge the budget like the default path.
+                if not allow_failure_restart:
+                    return None
+                return f"failed (podFailurePolicy Restart{f', {pod_reason}' if pod_reason else ''})"
+            if restart_policy == RESTART_POLICY_ON_FAILURE:
+                if not allow_failure_restart:
+                    return None  # budget exhausted; never launder via staleness
+                return f"failed{f' ({pod_reason})' if pod_reason else ''}"
         annotations = pod["metadata"].get("annotations") or {}
         stamp = annotations.get(constants.WORLD_SIZE_ANNOTATION)
         if stamp != str(replicas):
@@ -962,16 +1011,38 @@ class TPUJobController:
         restart_policy = worker_spec.restart_policy if worker_spec else ""
         phases = [_pod_phase(p) for p in workers]
         if any(p == POD_FAILED for p in phases):
-            if restart_policy != RESTART_POLICY_ON_FAILURE:
+            failed = [p for p in workers if _pod_phase(p) == POD_FAILED]
+            rules = [self._pod_failure_rule(job, p) for p in failed]
+            if any(
+                r is not None and r.action == POD_FAILURE_POLICY_ACTION_FAIL_JOB
+                for r in rules
+            ):
                 return True
-            # OnFailure failures are terminal once the gang is no longer
-            # rejoinable (a Succeeded rank's process is gone forever) or
-            # the restart budget is spent.
+            # A policy-matched (Ignore/Restart) pod is replaceable even
+            # under restartPolicy Never; an unmatched one is terminal
+            # unless OnFailure replacement applies.
+            if restart_policy != RESTART_POLICY_ON_FAILURE and any(
+                r is None for r in rules
+            ):
+                return True
+            # Failures are terminal once the gang is no longer rejoinable
+            # (a Succeeded rank's process is gone forever) or the restart
+            # budget is spent — Ignore-matched failures never charge the
+            # budget, so they alone cannot exhaust it.
             if any(p == POD_SUCCEEDED for p in phases):
                 return True
             backoff = job.spec.run_policy.backoff_limit
             status = job.status.replica_statuses.get(REPLICA_TYPE_WORKER)
-            if backoff is not None and status and status.restarts >= backoff:
+            charges_budget = any(
+                r is None or r.action != POD_FAILURE_POLICY_ACTION_IGNORE
+                for r in rules
+            )
+            if (
+                charges_budget
+                and backoff is not None
+                and status
+                and status.restarts >= backoff
+            ):
                 return True
             return False
         # len(workers) may exceed replicas (scale-down patched after the
@@ -1024,6 +1095,7 @@ class TPUJobController:
 
         running = evicted = succeeded = 0
         failed_pods: list[str] = []
+        failed_objs: list[dict] = []
         st.initialize_replica_statuses(job, REPLICA_TYPE_WORKER)
         wstatus = job.status.replica_statuses[REPLICA_TYPE_WORKER]
         for pod in workers:
@@ -1031,6 +1103,7 @@ class TPUJobController:
             if phase == POD_FAILED:
                 wstatus.failed += 1
                 failed_pods.append(pod["metadata"]["name"])
+                failed_objs.append(pod)
                 if (pod.get("status") or {}).get("reason") == "Evicted":
                     evicted += 1
             elif phase == POD_SUCCEEDED:
@@ -1107,7 +1180,23 @@ class TPUJobController:
                 backoff = job.spec.run_policy.backoff_limit
                 reason = st.TPUJOB_FAILED_REASON
                 detail = ""
-                if (
+                failjob_rule = next(
+                    (
+                        r
+                        for r in (
+                            self._pod_failure_rule(job, p) for p in failed_objs
+                        )
+                        if r is not None
+                        and r.action == POD_FAILURE_POLICY_ACTION_FAIL_JOB
+                    ),
+                    None,
+                )
+                if failjob_rule is not None:
+                    # A FailJob rule match fails fast — assertion-style exit
+                    # codes must not burn through backoffLimit retries.
+                    reason = JOB_POD_FAILURE_POLICY_REASON
+                    detail = " matching a podFailurePolicy FailJob rule"
+                elif (
                     backoff is not None
                     and wstatus.restarts >= backoff
                 ):
@@ -1227,23 +1316,28 @@ class TPUJobController:
         The job came from the informer cache, whose resourceVersion can
         trail the apiserver right after our own writes; on Conflict,
         re-GET the live object, transplant the freshly computed status
-        onto it, and retry once. Safety valve: if a concurrent writer
-        already drove the live status terminal and ours is not, DROP the
-        write instead — a stale-computed status must never resurrect a
-        finished job (the next sync recomputes from fresh state). A
-        second conflict falls through to the workqueue's rate-limited
-        requeue as before."""
+        onto it, and retry under runtime/retry's capped jittered backoff.
+        Safety valve: if a concurrent writer already drove the live
+        status terminal and ours is not, DROP the write instead — a
+        stale-computed status must never resurrect a finished job (the
+        next sync recomputes from fresh state). Exhausting the backoff
+        falls through to the workqueue's rate-limited requeue as
+        before."""
         job.status.last_reconcile_time = self.clock()
         client = self.tpujobs.tpujobs(job.namespace)
-        try:
-            client.update_status(job)
-        except ConflictError:
-            live = client.get(job.name)
-            if st.is_finished(live.status) and not st.is_finished(job.status):
-                self.log.info(
-                    "dropping stale status write: live status is already "
-                    "terminal", namespace=job.namespace, tpujob=job.name,
-                )
-                return
-            live.status = job.status
-            client.update_status(live)
+
+        def attempt():
+            try:
+                client.update_status(job)
+            except ConflictError:
+                live = client.get(job.name)
+                if st.is_finished(live.status) and not st.is_finished(job.status):
+                    self.log.info(
+                        "dropping stale status write: live status is already "
+                        "terminal", namespace=job.namespace, tpujob=job.name,
+                    )
+                    return
+                live.status = job.status
+                client.update_status(live)
+
+        retry.retry_on_conflict(attempt, retry.DEFAULT_RETRY)

@@ -6,19 +6,105 @@ pi MPI_Reduce e2e payload (/root/reference/examples/v2beta1/pi/pi.cc:19-50)
 rolled into one TPU-native program: join the jax.distributed world, run a
 real cross-host allgather, verify every rank contributed, exit 0.
 
+Failure taxonomy: the common startup races each get a distinct exit code
+(below) so a TPUJob ``runPolicy.podFailurePolicy`` rule can match them —
+e.g. Restart on DNS-not-ready/connection-refused (the coordinator pod is
+simply not up yet) while a genuine collective failure still burns the
+backoff budget.  Every preflight probe runs under its own timeout
+(``TPUJOB_HEALTHCHECK_PROBE_TIMEOUT_S``, default 5s) so a black-holed
+dial cannot eat the whole barrier budget.
+
 Run as ``python -m mpi_operator_tpu.launcher.healthcheck``.
 """
 
 from __future__ import annotations
 
+import os
+import socket
 import sys
 
-from ..utils.logging import emit_json
+from ..api.v2beta1 import constants
+from ..utils.logging import emit_json, get_logger
 from .bootstrap import RendezvousConfig, initialize
 
+log = get_logger("launcher.healthcheck")
 
-def run_healthcheck(config: RendezvousConfig | None = None) -> dict:
-    cfg = initialize(config)
+# Exit codes (stable contract for podFailurePolicy onExitCodes rules).
+EXIT_OK = 0
+EXIT_UNHEALTHY = 1  # world assembled but the collective check failed
+EXIT_DNS_NOT_READY = 12  # coordinator hostname does not resolve yet
+EXIT_CONNECTION_REFUSED = 13  # resolves, but nothing is listening yet
+EXIT_BARRIER_TIMEOUT = 14  # gang never fully assembled
+
+ENV_PROBE_TIMEOUT = "TPUJOB_HEALTHCHECK_PROBE_TIMEOUT_S"
+DEFAULT_PROBE_TIMEOUT_S = 5.0
+
+
+class ProbeFailure(RuntimeError):
+    """A preflight probe failed; carries the exit code to die with."""
+
+    def __init__(self, exit_code: int, message: str):
+        super().__init__(message)
+        self.exit_code = exit_code
+
+
+def probe_rendezvous(
+    cfg: RendezvousConfig, *, timeout_s: float = DEFAULT_PROBE_TIMEOUT_S
+) -> None:
+    """Preflight the rendezvous path, one bounded probe at a time.
+
+    1. Resolve the coordinator hostname (headless-service DNS records
+       only appear once the coordinator pod has an IP) — failure is
+       ``EXIT_DNS_NOT_READY``.
+    2. Non-coordinator ranks dial the barrier side port (coordinator
+       port + 1) — a refused/unreachable dial is
+       ``EXIT_CONNECTION_REFUSED``.  Rank 0 skips this: it hosts the
+       barrier itself.
+
+    Each probe gets its own ``timeout_s`` budget; raises ProbeFailure.
+    """
+    if not cfg.is_distributed or not cfg.coordinator_address:
+        return
+    host, _, port_str = cfg.coordinator_address.partition(":")
+    port = int(port_str or constants.DEFAULT_COORDINATOR_PORT)
+    try:
+        infos = socket.getaddrinfo(host, port, type=socket.SOCK_STREAM)
+    except socket.gaierror as e:
+        raise ProbeFailure(
+            EXIT_DNS_NOT_READY,
+            f"coordinator {host!r} does not resolve yet: {e}",
+        )
+    if not infos:
+        raise ProbeFailure(
+            EXIT_DNS_NOT_READY, f"coordinator {host!r} resolved to nothing"
+        )
+    if cfg.is_coordinator:
+        return
+    barrier_port = port + 1
+    try:
+        with socket.create_connection((host, barrier_port), timeout=timeout_s):
+            pass  # reachable; the barrier server drops silent probes
+    except OSError as e:
+        raise ProbeFailure(
+            EXIT_CONNECTION_REFUSED,
+            f"barrier port {host}:{barrier_port} not accepting: {e}",
+        )
+
+
+def run_healthcheck(
+    config: RendezvousConfig | None = None,
+    *,
+    probe_timeout_s: float = DEFAULT_PROBE_TIMEOUT_S,
+    barrier_timeout_s: float = 300.0,
+) -> dict:
+    cfg = config or RendezvousConfig.from_env()
+    probe_rendezvous(cfg, timeout_s=probe_timeout_s)
+    try:
+        cfg = initialize(
+            cfg, initialization_timeout_seconds=int(barrier_timeout_s)
+        )
+    except TimeoutError as e:
+        raise ProbeFailure(EXIT_BARRIER_TIMEOUT, str(e))
     import jax
     import numpy as np
 
@@ -48,11 +134,25 @@ def run_healthcheck(config: RendezvousConfig | None = None) -> dict:
 
 
 def main() -> int:
-    result = run_healthcheck()
+    try:
+        probe_timeout_s = float(
+            os.environ.get(ENV_PROBE_TIMEOUT, DEFAULT_PROBE_TIMEOUT_S)
+        )
+    except ValueError:
+        probe_timeout_s = DEFAULT_PROBE_TIMEOUT_S
+    try:
+        result = run_healthcheck(probe_timeout_s=probe_timeout_s)
+    except ProbeFailure as e:
+        log.warning("healthcheck probe failed: %s", e)
+        emit_json(
+            {"ok": False, "error": str(e), "exit_code": e.exit_code},
+            stream=sys.stdout,
+        )
+        return e.exit_code
     # Machine-readable result on stdout (one JSON line, sorted keys) via
     # the shared structured-log writer, so consumers keep a stable shape.
     emit_json(result, stream=sys.stdout)
-    return 0 if result["ok"] else 1
+    return EXIT_OK if result["ok"] else EXIT_UNHEALTHY
 
 
 if __name__ == "__main__":

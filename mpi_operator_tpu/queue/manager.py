@@ -52,6 +52,7 @@ from ..api.v2beta1.types import (
     TPUJob,
 )
 from ..controller import status as st
+from ..runtime import retry
 from ..runtime.apiserver import (
     AlreadyExistsError,
     ConflictError,
@@ -213,7 +214,7 @@ class QueueManager:
         def pump_loop():
             while not stop.is_set():
                 if self.factory.pump_all() == 0:
-                    time.sleep(0.005)
+                    retry.sleep(0.005)
 
         def tick_loop():
             while not stop.is_set():
@@ -606,19 +607,18 @@ class QueueManager:
         """Flip ``runPolicy.suspend`` on the live object (the one
         spec-write this package is allowed; see tests/test_lint.py)."""
         client = self.tpujobs.tpujobs(job.namespace)
-        try:
+
+        def flip():
             live = client.get(job.name)
-        except NotFoundError:
-            return None
-        if bool(live.spec.run_policy.suspend) == value:
-            return live
-        live.spec.run_policy.suspend = value
-        try:
-            return client.update(live)
-        except ConflictError:
-            live = client.get(job.name)
+            if bool(live.spec.run_policy.suspend) == value:
+                return live
             live.spec.run_policy.suspend = value
             return client.update(live)
+
+        try:
+            return retry.retry_on_conflict(flip, retry.DEFAULT_RETRY)
+        except NotFoundError:
+            return None
 
     def _set_job_condition(
         self, job: TPUJob, type_: str, reason: str, message: str, *,
@@ -638,15 +638,17 @@ class QueueManager:
 
     def _write_status(self, job: TPUJob) -> None:
         client = self.tpujobs.tpujobs(job.namespace)
-        try:
-            client.update_status(job)
-        except ConflictError:
+
+        def attempt():
             try:
+                client.update_status(job)
+            except ConflictError:
                 live = client.get(job.name)
-            except NotFoundError:
-                return
-            live.status = job.status
-            client.update_status(live)
+                live.status = job.status
+                client.update_status(live)
+
+        try:
+            retry.retry_on_conflict(attempt, retry.DEFAULT_RETRY)
         except NotFoundError:
             pass
 

@@ -66,6 +66,29 @@ class InvalidError(ApiError):
     reason = "Invalid"
 
 
+class ServerError(ApiError):
+    """Transient 500 (apiserver hiccup); retriable via runtime/retry."""
+
+    code = 500
+    reason = "InternalError"
+
+
+class ServerTimeoutError(ApiError):
+    """504: the request may or may not have been applied (chaos treats it
+    as not applied, the strictest interpretation for callers)."""
+
+    code = 504
+    reason = "Timeout"
+
+
+class GoneError(ApiError):
+    """410 Gone: the watch fell behind a compaction and must relist
+    (client-go reflector's ``ResourceExpired`` relist trigger)."""
+
+    code = 410
+    reason = "Gone"
+
+
 # Watch event types (k8s watch.EventType analog).
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"

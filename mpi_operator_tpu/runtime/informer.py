@@ -18,10 +18,18 @@ from __future__ import annotations
 
 import copy
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from .apiserver import ADDED, DELETED, InMemoryAPIServer, match_labels
+from .apiserver import (
+    ADDED,
+    DELETED,
+    ApiError,
+    GoneError,
+    InMemoryAPIServer,
+    match_labels,
+)
 
 
 def split_key(key: str) -> tuple[str, str]:
@@ -64,15 +72,30 @@ class Lister:
 
 
 class Informer:
-    def __init__(self, api: InMemoryAPIServer, resource: str, namespace: str = ""):
+    def __init__(
+        self,
+        api: InMemoryAPIServer,
+        resource: str,
+        namespace: str = "",
+        resync_interval: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self._api = api
         self.resource = resource
         self.namespace = namespace  # "" = cluster-wide (server.go:139-147 analog)
+        # Reflector resyncPeriod analog: when set, pump() periodically
+        # relists so events lost in flight (a lossy watch under fault
+        # injection) cannot leave the cache stale forever.
+        self.resync_interval = resync_interval
+        self._clock = clock
         self._lock = threading.RLock()
         self._cache: dict[str, dict] = {}
         self._handlers: list[EventHandler] = []
         self._watch = None
         self._synced = False
+        self._stopped = False
+        self._need_resync = False
+        self._last_sync = clock()
         self.lister = Lister(self)
 
     # -- cache reads -----------------------------------------------------
@@ -129,15 +152,43 @@ class Informer:
         with self._lock:
             if self._watch is not None:
                 return
-            ns = self.namespace or None
-            self._watch = self._api.watch(self.resource, namespace=ns)
-            # REST watches already paid for a baseline LIST (their 410
-            # resume mirror); reuse it instead of issuing a second full
-            # LIST per resource against the apiserver.
-            if hasattr(self._watch, "baseline"):
-                listing = self._watch.baseline()
+            self._stopped = False
+        self.resync()
+
+    def resync(self) -> None:
+        """(Re)open the watch and replace the cache from a fresh list.
+
+        Reflector ListAndWatch relist analog: called at start, after the
+        watch reports 410 Gone (compaction), and on the periodic resync
+        interval.  Objects that vanished fire on_delete; everything else
+        re-fires on_add (no-op adds collapse in the workqueue, as in
+        client-go's resync).  Raises ApiError if the relist itself fails;
+        pump() treats that as "still stale, retry next round".
+        """
+        with self._lock:
+            if self._stopped:
+                return
+            old_watch, self._watch = self._watch, None
+        if old_watch is not None:
+            old_watch.stop()
+        ns = self.namespace or None
+        watch = self._api.watch(self.resource, namespace=ns)
+        # REST watches already paid for a baseline LIST (their 410
+        # resume mirror); reuse it instead of issuing a second full
+        # LIST per resource against the apiserver.
+        try:
+            if hasattr(watch, "baseline"):
+                listing = watch.baseline()
             else:
                 listing = self._api.list(self.resource, ns)
+        except ApiError:
+            watch.stop()
+            raise
+        with self._lock:
+            if self._stopped:
+                watch.stop()
+                return
+            self._watch = watch
             fresh = {
                 meta_namespace_key(obj): obj
                 for obj in listing
@@ -148,6 +199,8 @@ class Informer:
             ]
             self._cache = fresh
             self._synced = True
+            self._need_resync = False
+            self._last_sync = self._clock()
         # Handlers fire outside the lock.
         for obj in removed:
             for h in self._handlers:
@@ -173,13 +226,34 @@ class Informer:
         # (the pump loop is not joined before stop_all at step-down).
         with self._lock:
             watch = self._watch
+            stale = self._need_resync
         if watch is None:
             if not self._synced:
                 raise RuntimeError(
-                    f"informer for {self.kind} not started; call start() first"
+                    f"informer for {self.resource} not started; call start() first"
                 )
             return 0  # started, then stopped: clean shutdown
-        events = watch.drain()
+        if not stale and self.resync_interval is not None:
+            stale = self._clock() - self._last_sync >= self.resync_interval
+        if stale:
+            with self._lock:
+                self._need_resync = True  # sticky until a relist succeeds
+            try:
+                self.resync()
+            except ApiError:
+                return 0  # apiserver unavailable; retry next pump
+            with self._lock:
+                watch = self._watch
+            if watch is None:
+                return 0
+        try:
+            events = watch.drain()
+        except GoneError:
+            # Compacted away mid-stream: the buffer is suspect; relist on
+            # the next pump round (keeps this round cheap and non-raising).
+            with self._lock:
+                self._need_resync = True
+            return 0
         for event in events:
             if not self._in_scope(event.object):
                 continue
@@ -207,6 +281,7 @@ class Informer:
 
     def stop(self) -> None:
         with self._lock:
+            self._stopped = True
             if self._watch is not None:
                 self._watch.stop()
                 self._watch = None
@@ -219,17 +294,33 @@ class InformerFactory:
     informers.NewSharedInformerFactory in app/server.go:139-147.
     """
 
-    def __init__(self, api: InMemoryAPIServer, namespace: str = ""):
+    def __init__(
+        self,
+        api: InMemoryAPIServer,
+        namespace: str = "",
+        resync_interval: Optional[float] = None,
+    ):
         self._api = api
         self.namespace = namespace
+        self.resync_interval = resync_interval
         self._informers: dict[str, Informer] = {}
 
     def informer(self, resource: str) -> Informer:
         if resource not in self._informers:
             self._informers[resource] = Informer(
-                self._api, resource, namespace=self.namespace
+                self._api,
+                resource,
+                namespace=self.namespace,
+                resync_interval=self.resync_interval,
             )
         return self._informers[resource]
+
+    def set_resync_interval(self, seconds: Optional[float]) -> None:
+        """Apply a resync period to existing and future informers (lets a
+        chaos harness arm resync on a controller-owned factory)."""
+        self.resync_interval = seconds
+        for informer in self._informers.values():
+            informer.resync_interval = seconds
 
     def start_all(self) -> None:
         for informer in self._informers.values():

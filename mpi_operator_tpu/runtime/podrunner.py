@@ -33,6 +33,7 @@ from typing import Optional
 from ..api.v2beta1 import constants
 from ..utils import flightrecorder
 from ..utils.logging import get_logger
+from . import retry
 from .apiserver import (
     ADDED,
     DELETED,
@@ -189,19 +190,20 @@ class LocalPodRunner:
         if not self.auto_bind:
             return None
         key = self._event_key(pod)
-        for _ in range(2):  # one conflict retry, then next watch event
-            try:
-                fresh = self.api.get("pods", key[0], key[1])
-            except NotFoundError:
-                return None
+
+        def bind():
+            fresh = self.api.get("pods", key[0], key[1])
             if fresh["spec"].get("nodeName"):
                 return fresh
             fresh["spec"]["nodeName"] = self.node_name
-            try:
-                return self.api.update("pods", fresh)
-            except ConflictError:
-                continue
-        return None
+            return self.api.update("pods", fresh)
+
+        try:
+            return retry.retry_on_conflict(bind, retry.Backoff(steps=2, duration=0.005))
+        except NotFoundError:
+            return None
+        except ConflictError:
+            return None  # give up until the next watch event
 
     def _maybe_start_pod(self, pod: dict) -> None:
         key = self._event_key(pod)
@@ -266,8 +268,11 @@ class LocalPodRunner:
                     self._pods.pop(key, None)
                 continue
             restart_policy = pod["spec"].get("restartPolicy", "Never")
+            # Container exit code convention: a signal death reports
+            # 128+signal (SIGKILL → 137), the code TPU preemptions show.
+            exit_code = rc if rc >= 0 else 128 - rc
             if rc == 0:
-                self._set_phase(key, "Succeeded")
+                self._set_phase(key, "Succeeded", exit_code=0)
                 with self._lock:
                     self._pods.pop(key, None)
             elif restart_policy == "OnFailure" and running.restarts < MAX_RESTARTS:
@@ -290,15 +295,49 @@ class LocalPodRunner:
                     )
             else:
                 self._set_phase(
-                    key, "Failed", reason="Error", message=running.log[-1024:]
+                    key, "Failed", reason="Error", message=running.log[-1024:],
+                    exit_code=exit_code,
                 )
                 with self._lock:
                     self._pods.pop(key, None)
                 self._mirror_job_failure(pod)
         return progressed
 
+    # -- chaos hooks -----------------------------------------------------
+
+    def kill_pod(self, namespace: str, name: str) -> bool:
+        """Chaos hook: SIGKILL the pod's process.  The reaper classifies
+        the exit through the normal failure path (rc -9 → exitCode 137,
+        the code a TPU preemption reports)."""
+        with self._lock:
+            running = self._pods.get((namespace, name))
+        if running is None or running.process.poll() is not None:
+            return False
+        running.process.kill()
+        return True
+
+    def fail_node(self, namespace: str, name: str) -> bool:
+        """Chaos hook: the pod's node dies — the process is killed and the
+        pod flips straight to Failed/NodeLost with no exit code (a dead
+        kubelet never reports one), exercising condition/reason matching
+        in podFailurePolicy rather than exit-code matching."""
+        key = (namespace, name)
+        with self._lock:
+            running = self._pods.pop(key, None)
+        if running is None:
+            return False
+        if running.process.poll() is None:
+            running.process.kill()
+        self._set_phase(key, "Failed", reason="NodeLost", message="node died")
+        return True
+
     def _set_phase(
-        self, key: tuple[str, str], phase: str, reason: str = "", message: str = ""
+        self,
+        key: tuple[str, str],
+        phase: str,
+        reason: str = "",
+        message: str = "",
+        exit_code: Optional[int] = None,
     ) -> None:
         try:
             pod = self.api.get("pods", key[0], key[1])
@@ -312,6 +351,16 @@ class LocalPodRunner:
             status["reason"] = reason
         if message:
             status["message"] = message
+        if exit_code is not None:
+            # Surface the container exit code the way a kubelet would, so
+            # podFailurePolicy onExitCodes rules have something to match.
+            container = (pod["spec"].get("containers") or [{}])[0]
+            status["containerStatuses"] = [
+                {
+                    "name": container.get("name", "main"),
+                    "state": {"terminated": {"exitCode": exit_code}},
+                }
+            ]
         pod["status"] = status
         try:
             self.api.update_status("pods", pod)

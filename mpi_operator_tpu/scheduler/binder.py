@@ -23,6 +23,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Optional
 
+from ..runtime import retry
 from ..runtime.apiserver import ConflictError, NotFoundError
 from ..utils.logging import get_logger
 
@@ -48,7 +49,8 @@ def set_pod_condition(pod: dict, cond: dict) -> None:
 
 
 class Binder:
-    """Writes assignments to the API server with one conflict retry."""
+    """Writes assignments to the API server under conflict-retry backoff
+    (runtime/retry.retry_on_conflict)."""
 
     def __init__(self, api, clock=time.time):
         self._api = api
@@ -56,7 +58,9 @@ class Binder:
         self._log = get_logger("scheduler.binder")
 
     def bind(self, namespace: str, name: str, node_name: str) -> dict:
-        for attempt in (1, 2):
+        def attempt() -> dict:
+            # Each attempt re-reads the pod: a conflict means someone else
+            # wrote it, so retrying the stale copy would conflict forever.
             try:
                 pod = self._api.get("pods", namespace, name)
             except NotFoundError:
@@ -70,24 +74,16 @@ class Binder:
                 )
             set_pod_condition(pod, scheduled_condition("True"))
             pod["status"].setdefault("phase", "Pending")
-            try:
-                pod = self._api.update_status("pods", pod)
-            except ConflictError:
-                if attempt == 2:
-                    raise BindError(f"status conflict binding {namespace}/{name}")
-                continue
+            pod = self._api.update_status("pods", pod)
             pod["spec"]["nodeName"] = node_name
-            try:
-                bound = self._api.update("pods", pod)
-            except ConflictError:
-                if attempt == 2:
-                    raise BindError(f"spec conflict binding {namespace}/{name}")
-                continue
-            self._log.debug(
-                "bound pod %s/%s to %s", namespace, name, node_name
-            )
-            return bound
-        raise BindError(f"could not bind {namespace}/{name}")  # pragma: no cover
+            return self._api.update("pods", pod)
+
+        try:
+            bound = retry.retry_on_conflict(attempt, retry.DEFAULT_RETRY)
+        except ConflictError:
+            raise BindError(f"conflict binding {namespace}/{name}")
+        self._log.debug("bound pod %s/%s to %s", namespace, name, node_name)
+        return bound
 
     def mark_unschedulable(self, namespace: str, name: str, message: str) -> None:
         """Surface ``PodScheduled=False/Unschedulable`` on the pod, the

@@ -126,10 +126,29 @@ class CheckpointManager:
         new stage split, then placed with ``like``'s sharding. A
         preempted slice rarely comes back the same shape; without this
         a resume onto a resized pipeline died on a shape mismatch.
+
+        Torn-write tolerance: a step directory truncated mid-save (the
+        writer was preempted before orbax committed) must not brick the
+        resume — an unreadable step is skipped with a warning and the
+        next-newest step is tried, down to a cold start when nothing is
+        readable.
         """
-        step = self._mgr.latest_step()
-        if step is None:
-            return None, like
+        steps = sorted(self._mgr.all_steps() or (), reverse=True)
+        for step in steps:
+            try:
+                return self._restore_step(step, like)
+            except Exception as e:
+                log.warning(
+                    "checkpoint at step %d is unreadable (%s: %s); "
+                    "falling back to an older step",
+                    step, type(e).__name__, e,
+                )
+        if steps:
+            log.warning("no readable checkpoint among steps %s; starting "
+                        "cold", steps)
+        return None, like
+
+    def _restore_step(self, step: int, like: Any) -> tuple[int, Any]:
         try:
             template, n_restacked = self._restack_template(step, like)
         except Exception as e:  # exotic container types: restore strict

@@ -120,6 +120,45 @@ class TestPythonEngine:
         retry.close()
 
 
+    def test_rank_re_checkin_after_connection_dropped(self):
+        # Harsher variant of the stale-connection case: the first rank-0
+        # connection is fully CLOSED (pod killed, TCP reset) before the
+        # replacement pod re-checks in.  The dead registration must not
+        # count toward the gang, and the retry must receive GO.
+        import struct
+
+        port = free_port()
+        results: dict = {}
+
+        def server():
+            results["serve"] = barrier._py_serve(port, 2, 10_000)
+
+        t = threading.Thread(target=server)
+        t.start()
+
+        deadline = time.monotonic() + 5
+        first = None
+        while first is None:
+            try:
+                first = socket.create_connection(("127.0.0.1", port), timeout=5)
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
+        first.sendall(barrier.MAGIC + struct.pack("<I", 0))
+        time.sleep(0.2)  # let the server register the doomed check-in
+        first.close()  # rank 0's pod dies before the gang completes
+
+        retry = socket.create_connection(("127.0.0.1", port), timeout=5)
+        retry.sendall(barrier.MAGIC + struct.pack("<I", 0))
+        assert barrier._py_wait("127.0.0.1", port, 1, 10_000) == 0
+        t.join(timeout=12)
+        assert results["serve"] == 0
+        retry.settimeout(5)
+        assert retry.recv(4) == barrier.GO
+        retry.close()
+
+
 class TestNativeEngine:
     def test_gang_of_8(self, native_lib):
         results = run_gang(

@@ -120,3 +120,48 @@ class TestSingleProcess:
         result = run_healthcheck(RendezvousConfig())
         assert result["ok"]
         assert result["local_device_count"] >= 1
+
+
+class TestHealthcheckProbes:
+    """Preflight probes must die with the distinct exit codes the
+    podFailurePolicy vocabulary documents (12 = DNS, 13 = refused)."""
+
+    def test_unresolvable_coordinator_is_dns_exit_code(self):
+        from mpi_operator_tpu.launcher import healthcheck
+
+        cfg = RendezvousConfig(
+            coordinator_address="no-such-host.invalid:8476",
+            num_processes=2,
+            process_id=1,
+        )
+        with pytest.raises(healthcheck.ProbeFailure) as exc:
+            healthcheck.probe_rendezvous(cfg, timeout_s=2.0)
+        assert exc.value.exit_code == healthcheck.EXIT_DNS_NOT_READY
+
+    def test_refused_barrier_dial_is_connection_exit_code(self):
+        import socket
+
+        from mpi_operator_tpu.launcher import healthcheck
+
+        # Reserve a port and close it so coordinator_port+1 refuses.
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        cfg = RendezvousConfig(
+            coordinator_address=f"127.0.0.1:{port - 1}",
+            num_processes=2,
+            process_id=1,  # non-coordinator: must dial the barrier port
+        )
+        with pytest.raises(healthcheck.ProbeFailure) as exc:
+            healthcheck.probe_rendezvous(cfg, timeout_s=2.0)
+        assert exc.value.exit_code == healthcheck.EXIT_CONNECTION_REFUSED
+
+    def test_coordinator_skips_barrier_dial(self):
+        from mpi_operator_tpu.launcher import healthcheck
+
+        cfg = RendezvousConfig(
+            coordinator_address="127.0.0.1:1",  # nothing listening anywhere
+            num_processes=2,
+            process_id=0,  # rank 0 hosts the barrier: no self-dial
+        )
+        healthcheck.probe_rendezvous(cfg, timeout_s=2.0)  # must not raise

@@ -388,3 +388,92 @@ def test_gauge_naming_conventions():
         "tpu_operator_pods_by_phase",
         "tpu_operator_job_condition",
     } <= names
+
+
+# Control-plane packages: writers that must stay responsive and honest
+# under fault injection (the chaos tier exercises exactly these paths).
+_CONTROL_PLANE_PREFIXES = (
+    "mpi_operator_tpu/controller/",
+    "mpi_operator_tpu/scheduler/",
+    "mpi_operator_tpu/queue/",
+)
+
+
+def test_no_bare_sleep_in_control_plane():
+    """Control-plane code never calls time.sleep directly: every pause
+    goes through runtime/retry.sleep (backoff delays and pump-loop idles
+    alike), the single monkeypatchable chokepoint that lets the chaos
+    soak and unit tests collapse wall-clock waits to zero."""
+    import ast
+
+    offenders = []
+    for rel, line, callee, node in _package_calls():
+        if callee != "sleep":
+            continue
+        if not rel.startswith(_CONTROL_PLANE_PREFIXES):
+            continue
+        fn = node.func
+        bare_name = isinstance(fn, ast.Name)  # `from time import sleep`
+        time_attr = (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "time"
+        )
+        if bare_name or time_attr:
+            offenders.append(
+                f"{rel}:{line}: bare sleep() — use runtime/retry.sleep"
+            )
+    assert not offenders, "\n".join(offenders)
+
+
+def test_no_swallowed_exceptions_in_control_plane():
+    """``except Exception: pass`` in controller/scheduler/queue silently
+    eats the very faults the chaos tier injects (a conflict or 500
+    vanishing instead of being retried or surfaced).  Handlers must
+    log, re-raise, or narrow the exception type."""
+    import ast
+
+    pkg = Path(__file__).resolve().parent.parent / "mpi_operator_tpu"
+    offenders = []
+    for path in sorted(pkg.rglob("*.py")):
+        rel = str(path.relative_to(pkg.parent)).replace("\\", "/")
+        if not rel.startswith(_CONTROL_PLANE_PREFIXES):
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = node.type is None or (
+                isinstance(node.type, ast.Name)
+                and node.type.id in ("Exception", "BaseException")
+            )
+            silent = all(isinstance(stmt, ast.Pass) for stmt in node.body)
+            if broad and silent:
+                offenders.append(
+                    f"{rel}:{node.lineno}: except Exception: pass swallows "
+                    "injected faults"
+                )
+    assert not offenders, "\n".join(offenders)
+
+
+def test_chaos_metrics_carry_subsystem_prefix():
+    """Every metric registered under mpi_operator_tpu/chaos/ must use the
+    tpu_operator_chaos_ subsystem prefix (one-matcher dashboards, like
+    the scheduler and queue), and the engine's advertised pair exists."""
+    chaos_metrics = [
+        (file, line, kind, name)
+        for file, line, kind, name in _registered_metric_names()
+        if str(file).replace("\\", "/").startswith("mpi_operator_tpu/chaos/")
+    ]
+    assert chaos_metrics, "chaos metric registrations went missing"
+    bad = [
+        f"{file}:{line} {kind}({name!r}): missing tpu_operator_chaos_ prefix"
+        for file, line, kind, name in chaos_metrics
+        if not name.startswith("tpu_operator_chaos_")
+    ]
+    assert not bad, "\n".join(bad)
+    names = {name for _, _, _, name in chaos_metrics}
+    assert {
+        "tpu_operator_chaos_faults_injected_total",
+        "tpu_operator_chaos_pod_kills_total",
+    } <= names
