@@ -37,7 +37,7 @@ from ..controller.tpu_job_controller import TPUJobController
 from ..runtime.apiserver import InMemoryAPIServer, NotFoundError
 from ..runtime.leaderelection import LeaderElectionConfig, LeaderElector
 from ..runtime.podrunner import LocalPodRunner
-from ..utils import flightrecorder, metrics, trace
+from ..utils import flightrecorder, metrics, profiling, trace
 from ..utils import logging as logutil
 from ..version import version_string
 
@@ -122,6 +122,8 @@ class _MonitoringHandler(BaseHTTPRequestHandler):
     registry: metrics.Registry = None
     tracer: trace.Tracer = None
     flight_recorder: Optional[flightrecorder.FlightRecorder] = None
+    profiler: Optional[profiling.PhaseProfiler] = None
+    workqueues: tuple = ()
     health_fn = staticmethod(lambda: True)
 
     def _timeline_body(self) -> Optional[bytes]:
@@ -155,6 +157,21 @@ class _MonitoringHandler(BaseHTTPRequestHandler):
             body = b"ok" if ok else b"unhealthy"
             self.send_response(200 if ok else 500)
             self.send_header("Content-Type", "text/plain")
+        elif self.path == "/debug/profile":
+            # Phase-level performance snapshot: where reconcile time goes
+            # (exclusive per-phase shares), watch→reconcile propagation
+            # quantiles, cache-scan volume, and workqueue health.
+            import json
+
+            doc = {
+                "profile": (
+                    self.profiler.snapshot() if self.profiler is not None else {}
+                ),
+                "workqueues": {q.name: q.stats() for q in self.workqueues},
+            }
+            body = (json.dumps(doc, indent=2, sort_keys=True) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
         elif self.path == "/debug/trace":
             # The span ring buffer as JSONL, oldest span first: one
             # reconcile cycle reads as a reconcile line followed by its
@@ -179,10 +196,14 @@ def start_monitoring(port: int, registry: metrics.Registry, health_fn,
                      address: str = "127.0.0.1",
                      tracer: Optional[trace.Tracer] = None,
                      flight_recorder: Optional[
-                         flightrecorder.FlightRecorder] = None):
+                         flightrecorder.FlightRecorder] = None,
+                     profiler: Optional[profiling.PhaseProfiler] = None,
+                     workqueues=()):
     """startMonitoring (main.go:29-40) + healthz server (:192-208) analog,
-    plus the ``/debug/trace`` span dump and per-job
-    ``/debug/jobs/<ns>/<name>/timeline`` flight-recorder endpoint."""
+    plus the ``/debug/trace`` span dump, per-job
+    ``/debug/jobs/<ns>/<name>/timeline`` flight-recorder endpoint, and the
+    ``/debug/profile`` phase-profile snapshot (``profiler`` plus the
+    ``workqueues`` whose health it reports)."""
     handler = type(
         "Handler",
         (_MonitoringHandler,),
@@ -191,6 +212,8 @@ def start_monitoring(port: int, registry: metrics.Registry, health_fn,
             # "is None", not "or": an empty Tracer is falsy (__len__).
             "tracer": trace.DEFAULT_TRACER if tracer is None else tracer,
             "flight_recorder": flight_recorder,
+            "profiler": profiler,
+            "workqueues": tuple(workqueues),
             "health_fn": staticmethod(health_fn),
         },
     )
@@ -424,9 +447,13 @@ def run(argv=None) -> int:
     # against a half-initialized process.
     if args.monitoring_port:
         health = elector.healthy if elector is not None else (lambda: True)
+        queues = [controller.queue]
+        if queue_manager is not None:
+            queues.append(queue_manager.queue)
         start_monitoring(
             args.monitoring_port, registry, health,
             address=args.monitoring_address, flight_recorder=recorder,
+            profiler=profiling.profiler_for(registry), workqueues=queues,
         )
         print(
             f"monitoring on http://{args.monitoring_address}:"

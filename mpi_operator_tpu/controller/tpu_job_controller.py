@@ -58,7 +58,7 @@ from ..runtime.client import KubeClient, SchedulingClient, TPUJobClient
 from ..runtime.informer import EventHandler, InformerFactory, meta_namespace_key, split_key
 from ..runtime.objects import KubeObject
 from ..runtime.workqueue import RateLimitingQueue
-from ..utils import flightrecorder, metrics, statemetrics, trace
+from ..utils import flightrecorder, metrics, profiling, statemetrics, trace
 from ..utils import logging as logutil
 from ..utils.events import (
     EVENT_TYPE_NORMAL,
@@ -184,9 +184,15 @@ class TPUJobController:
             registry,
         )
 
+        # Phase-level attribution (shared per registry: the queue manager
+        # reuses this instance when it shares our registry).
+        self.profiler = profiling.profiler_for(registry)
+
         # Namespace-scoped or cluster-wide informers (server.go:139-147
         # analog): "" watches all namespaces.
-        self.factory = InformerFactory(api, namespace=namespace)
+        self.factory = InformerFactory(
+            api, namespace=namespace, profiler=self.profiler
+        )
         self.tpujob_informer = self.factory.informer("tpujobs")
         self.pod_informer = self.factory.informer("pods")
         self.service_informer = self.factory.informer("services")
@@ -235,7 +241,13 @@ class TPUJobController:
         # Plain add: the exponential backoff is reserved for the error path
         # (process_next_work_item), so a flood of healthy events never
         # inflates a key's failure counter.
-        self.queue.add(meta_namespace_key(obj))
+        key = meta_namespace_key(obj)
+        # Watch-to-reconcile attribution: when this enqueue is a watch
+        # event being dispatched (pump sets the stamp), remember the
+        # event's emission time under the key we enqueue — which may be
+        # an owner's key, not the event object's own.
+        self.profiler.note_event(key, profiling.current_event_stamp())
+        self.queue.add(key)
 
     def _handle_object(self, obj: dict) -> None:
         """ownerRef walk (handleObject :1033-1068 analog), including the
@@ -400,24 +412,30 @@ class TPUJobController:
         direct test drive — lands in the latency histogram, the error
         counter, and the trace ring buffer."""
         t0 = time.perf_counter()
+        self.profiler.observe_dequeue(key)
         with self.tracer.span("reconcile", key=key):
             try:
                 self._sync_job(key)
             except Exception as e:
-                self.sync_duration.observe(time.perf_counter() - t0, "error")
+                elapsed = time.perf_counter() - t0
+                self.sync_duration.observe(elapsed, "error")
                 self.sync_errors.inc(1, type(e).__name__)
+                self.profiler.observe_pass(elapsed)
                 raise
             # Inside the span so the record carries its trace id.
             self.log.debug(
                 "synced %s", key,
                 duration_ms=round((time.perf_counter() - t0) * 1000.0, 3),
             )
-        self.sync_duration.observe(time.perf_counter() - t0, "success")
+        elapsed = time.perf_counter() - t0
+        self.sync_duration.observe(elapsed, "success")
+        self.profiler.observe_pass(elapsed)
 
     def _sync_job(self, key: str) -> None:
         """:451-589 analog."""
         namespace, name = split_key(key)
-        shared = self.tpujob_informer.lister.get(namespace, name)
+        with self.profiler.phase(profiling.PHASE_CACHE_READ):
+            shared = self.tpujob_informer.lister.get(namespace, name)
         if shared is None:
             # Deleted; dependents go via GC. Drop its condition-transition
             # timestamps (state metrics recompute from the cache, so their
@@ -504,17 +522,24 @@ class TPUJobController:
         else:
             done = self._workers_done(job, workers)
         if not done:
-            self._get_or_create_service(job, builders.new_workers_service(job))
+            with self.profiler.phase(profiling.PHASE_RENDER):
+                desired_service = builders.new_workers_service(job)
+            self._get_or_create_service(job, desired_service)
             self._get_or_create_config_map(job)
             if self.gang_scheduler_name:
                 min_member = builders.worker_replicas(job) + (1 if has_launcher_spec else 0)
                 self._get_or_create_pod_group(job, min_member)
             workers = self._get_or_create_workers(job)
             if has_launcher_spec and launcher is None:
-                try:
-                    launcher_obj = self.kube.jobs(namespace).create(
-                        builders.new_launcher_job(job, self.gang_scheduler_name)
+                with self.profiler.phase(profiling.PHASE_RENDER):
+                    desired_launcher = builders.new_launcher_job(
+                        job, self.gang_scheduler_name
                     )
+                try:
+                    with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                        launcher_obj = self.kube.jobs(namespace).create(
+                            desired_launcher
+                        )
                     launcher = launcher_obj.to_dict()
                 except AlreadyExistsError:
                     # Stale cache (see _get_or_create_service docstring).
@@ -579,7 +604,10 @@ class TPUJobController:
 
     def _get_launcher_job(self, job: TPUJob) -> Optional[dict]:
         """getLauncherJob :592-613 analog."""
-        existing = self.job_informer.lister.get(job.namespace, builders.launcher_name(job))
+        with self.profiler.phase(profiling.PHASE_CACHE_READ):
+            existing = self.job_informer.lister.get(
+                job.namespace, builders.launcher_name(job)
+            )
         if existing is None:
             return None
         if not is_controlled_by(existing, job):
@@ -598,10 +626,12 @@ class TPUJobController:
         itself just did, and aborting costs a whole backoff requeue (the
         reference pays that requeue; measured directly in our startup
         bench latency)."""
-        existing = self.service_informer.lister.get(job.namespace, desired.name)
+        with self.profiler.phase(profiling.PHASE_CACHE_READ):
+            existing = self.service_informer.lister.get(job.namespace, desired.name)
         if existing is None:
             try:
-                return self.kube.services(job.namespace).create(desired).to_dict()
+                with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                    return self.kube.services(job.namespace).create(desired).to_dict()
             except AlreadyExistsError:
                 existing = self._read_through_adopt(
                     self.kube.services(job.namespace), job, desired.name,
@@ -614,20 +644,25 @@ class TPUJobController:
         if existing.get("spec", {}).get("selector") != desired.spec.get("selector"):
             updated = KubeObject.from_dict(existing)
             updated.spec["selector"] = desired.spec.get("selector")
-            return self.kube.services(job.namespace).update(updated).to_dict()
+            with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                return self.kube.services(job.namespace).update(updated).to_dict()
         return existing
 
     def _get_or_create_config_map(self, job: TPUJob) -> dict:
         """getOrCreateConfigMap :692-733 analog: desired data computed every
         sync (including elastic discover-hosts) and diffed against stored."""
-        desired = builders.new_config_map(job, builders.worker_replicas(job))
+        with self.profiler.phase(profiling.PHASE_RENDER):
+            desired = builders.new_config_map(job, builders.worker_replicas(job))
         running = self._running_worker_pods(job)
-        builders.update_discover_hosts(desired, job, running)
+        with self.profiler.phase(profiling.PHASE_RENDER):
+            builders.update_discover_hosts(desired, job, running)
 
-        existing = self.configmap_informer.lister.get(job.namespace, desired.name)
+        with self.profiler.phase(profiling.PHASE_CACHE_READ):
+            existing = self.configmap_informer.lister.get(job.namespace, desired.name)
         if existing is None:
             try:
-                return self.kube.configmaps(job.namespace).create(desired).to_dict()
+                with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                    return self.kube.configmaps(job.namespace).create(desired).to_dict()
             except AlreadyExistsError:  # stale cache; see _get_or_create_service
                 existing = self._read_through_adopt(
                     self.kube.configmaps(job.namespace), job, desired.name,
@@ -658,23 +693,31 @@ class TPUJobController:
                 return self.kube.configmaps(job.namespace).update(refreshed).to_dict()
 
             try:
-                return self.kube.configmaps(job.namespace).update(updated).to_dict()
+                with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                    return self.kube.configmaps(job.namespace).update(updated).to_dict()
             except ConflictError:
                 # A persistent race past the backoff waits for the next
                 # sync (the workqueue requeues on error).
-                return retry.retry_on_conflict(rediff_and_write, retry.DEFAULT_RETRY)
+                with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                    return retry.retry_on_conflict(
+                        rediff_and_write, retry.DEFAULT_RETRY
+                    )
         return existing
 
     def _get_or_create_pod_group(self, job: TPUJob, min_member: int) -> dict:
         """getOrCreatePodGroups :616-637 analog."""
-        existing = self.podgroup_informer.lister.get(job.namespace, job.name)
+        with self.profiler.phase(profiling.PHASE_CACHE_READ):
+            existing = self.podgroup_informer.lister.get(job.namespace, job.name)
         if existing is None:
+            with self.profiler.phase(profiling.PHASE_RENDER):
+                desired = builders.new_pod_group(job, min_member)
             try:
-                return (
-                    self.scheduling.podgroups(job.namespace)
-                    .create(builders.new_pod_group(job, min_member))
-                    .to_dict()
-                )
+                with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                    return (
+                        self.scheduling.podgroups(job.namespace)
+                        .create(desired)
+                        .to_dict()
+                    )
             except AlreadyExistsError:  # stale cache; see _get_or_create_service
                 existing = self._read_through_adopt(
                     self.scheduling.podgroups(job.namespace), job, job.name,
@@ -696,14 +739,16 @@ class TPUJobController:
             self._flag_not_controlled(job, existing)
             raise RuntimeError(f"PodGroup {job.name} not controlled by us")
         try:
-            self.scheduling.podgroups(job.namespace).delete(job.name)
+            with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                self.scheduling.podgroups(job.namespace).delete(job.name)
         except NotFoundError:
             pass
 
     def _list_worker_pods(self, job: TPUJob) -> list[dict]:
-        return self.pod_informer.lister.list(
-            job.namespace, builders.worker_selector(job.name)
-        )
+        with self.profiler.phase(profiling.PHASE_CACHE_READ):
+            return self.pod_informer.lister.list(
+                job.namespace, builders.worker_selector(job.name)
+            )
 
     def _running_worker_pods(self, job: TPUJob) -> list[dict]:
         """getRunningWorkerPods :670-688 analog."""
@@ -732,7 +777,10 @@ class TPUJobController:
                     continue
                 if index >= replicas:
                     try:
-                        self.kube.pods(job.namespace).delete(pod["metadata"]["name"])
+                        with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                            self.kube.pods(job.namespace).delete(
+                                pod["metadata"]["name"]
+                            )
                     except NotFoundError:
                         pass
 
@@ -758,7 +806,8 @@ class TPUJobController:
             AlreadyExists-adopt paths: delete + backoff accounting +
             Restarting-condition material."""
             try:
-                self.kube.pods(job.namespace).delete(name)
+                with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                    self.kube.pods(job.namespace).delete(name)
             except NotFoundError:
                 pass
             if reason.startswith("failed"):
@@ -767,7 +816,8 @@ class TPUJobController:
 
         for i in range(replicas):
             name = builders.worker_name(job, i)
-            pod = self.pod_informer.lister.get(job.namespace, name)
+            with self.profiler.phase(profiling.PHASE_CACHE_READ):
+                pod = self.pod_informer.lister.get(job.namespace, name)
             if pod is not None and is_controlled_by(pod, job):
                 reason = self._elastic_restart_reason(
                     job, pod, replicas,
@@ -800,12 +850,17 @@ class TPUJobController:
                     else:
                         pod = fresh  # cache was stale; pod is already correct
             if pod is None:
-                try:
-                    pod = (
-                        self.kube.pods(job.namespace)
-                        .create(builders.new_worker(job, i, self.gang_scheduler_name))
-                        .to_dict()
+                with self.profiler.phase(profiling.PHASE_RENDER):
+                    desired_pod = builders.new_worker(
+                        job, i, self.gang_scheduler_name
                     )
+                try:
+                    with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                        pod = (
+                            self.kube.pods(job.namespace)
+                            .create(desired_pod)
+                            .to_dict()
+                        )
                 except AlreadyExistsError:
                     # Stale cache (see _get_or_create_service docstring).
                     # The adopted pod is live apiserver state, so the same
@@ -957,7 +1012,8 @@ class TPUJobController:
             if policy == "Running" and phase not in (POD_RUNNING, POD_PENDING):
                 continue  # keep completed pods (:886-891)
             try:
-                self.kube.pods(job.namespace).delete(name)
+                with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                    self.kube.pods(job.namespace).delete(name)
             except NotFoundError:
                 pass
 
@@ -990,7 +1046,10 @@ class TPUJobController:
         for pod in self._list_worker_pods(job):
             if is_controlled_by(pod, job):
                 try:
-                    self.kube.pods(job.namespace).delete(pod["metadata"]["name"])
+                    with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                        self.kube.pods(job.namespace).delete(
+                            pod["metadata"]["name"]
+                        )
                 except NotFoundError:
                     pass
 
@@ -1063,9 +1122,10 @@ class TPUJobController:
 
         launcher_pods: list[dict] = []
         if launcher is not None:
-            launcher_pods = self.pod_informer.lister.list(
-                job.namespace, {"job-name": launcher["metadata"]["name"]}
-            )
+            with self.profiler.phase(profiling.PHASE_CACHE_READ):
+                launcher_pods = self.pod_informer.lister.list(
+                    job.namespace, {"job-name": launcher["metadata"]["name"]}
+                )
             running_launchers = sum(
                 1 for p in launcher_pods if _pod_phase(p) == POD_RUNNING
             )
@@ -1340,4 +1400,5 @@ class TPUJobController:
                 live.status = job.status
                 client.update_status(live)
 
-        retry.retry_on_conflict(attempt, retry.DEFAULT_RETRY)
+        with self.profiler.phase(profiling.PHASE_STATUS_UPDATE):
+            retry.retry_on_conflict(attempt, retry.DEFAULT_RETRY)

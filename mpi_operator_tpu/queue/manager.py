@@ -63,7 +63,7 @@ from ..runtime.client import TPUJobClient
 from ..runtime.informer import EventHandler, InformerFactory, meta_namespace_key
 from ..runtime.workqueue import RateLimitingQueue
 from ..scheduler.core import DEFAULT_PRIORITIES
-from ..utils import flightrecorder, metrics
+from ..utils import flightrecorder, metrics, profiling
 from ..utils import logging as logutil
 from ..utils.events import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING, EventRecorder
 from .quota import Charge, JobKey, QueueQuota, QuotaLedger, insufficient_quota_message
@@ -156,6 +156,12 @@ class QueueManager:
         )
         registry.on_scrape(self._refresh_gauges)
 
+        # Shared per-registry profiler (profiler_for dedups with the
+        # controller when both run against one registry): the admission
+        # pass is one timed phase, and its three full-store lists are
+        # scan-accounted under the "queue_admit" scope.
+        self.profiler = profiling.profiler_for(registry)
+
         self.ledger = QuotaLedger()
         # Last-pass snapshots behind _lock: gauge values per queue and the
         # set of still-pending job keys (drives backoff requeues).
@@ -167,7 +173,7 @@ class QueueManager:
         self._last_failure_msg: Dict[str, str] = {}
 
         # Informers are *triggers* only — the pass lists from the API.
-        self.factory = InformerFactory(api, namespace="")
+        self.factory = InformerFactory(api, namespace="", profiler=self.profiler)
         self.tpujob_informer = self.factory.informer("tpujobs")
         self.clusterqueue_informer = self.factory.informer("clusterqueues")
         self.localqueue_informer = self.factory.informer("localqueues")
@@ -297,22 +303,22 @@ class QueueManager:
     # ------------------------------------------------------------------
 
     def _admit_pass(self) -> None:
+        with self.profiler.phase(profiling.PHASE_QUEUE_ADMISSION):
+            self._admit_pass_locked()
+
+    def _admit_pass_locked(self) -> None:
         with self._lock:
             now = self.clock()
+            cq_objs = self.api.list("clusterqueues")
+            lq_objs = self.api.list("localqueues")
             cluster_queues = {
                 cq.name: cq
-                for cq in (
-                    ClusterQueue.from_dict(o)
-                    for o in self.api.list("clusterqueues")
-                )
+                for cq in (ClusterQueue.from_dict(o) for o in cq_objs)
                 if cq.name
             }
             local_queues = {
                 (lq.namespace, lq.name): lq
-                for lq in (
-                    LocalQueue.from_dict(o)
-                    for o in self.api.list("localqueues")
-                )
+                for lq in (LocalQueue.from_dict(o) for o in lq_objs)
             }
             for name, cq in cluster_queues.items():
                 self.ledger.set_queue(
@@ -326,7 +332,14 @@ class QueueManager:
             for stale in set(self.ledger.queues()) - set(cluster_queues):
                 self.ledger.remove_queue(stale)
 
-            jobs = [TPUJob.from_dict(o) for o in self.api.list("tpujobs")]
+            job_objs = self.api.list("tpujobs")
+            # Every pass re-reads all three stores from apiserver truth —
+            # that is the point (fresh-list discipline) and the cost the
+            # scan counter makes visible.
+            self.profiler.record_scan(
+                "queue_admit", len(cq_objs) + len(lq_objs) + len(job_objs)
+            )
+            jobs = [TPUJob.from_dict(o) for o in job_objs]
             queued = [j for j in jobs if job_queue_name(j)]
 
             # Rebuild the ledger from admitted truth (cache.reconcile

@@ -30,6 +30,7 @@ import uuid
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from ..utils import profiling
 from ..utils.logging import DEBUG, get_logger
 
 
@@ -100,6 +101,10 @@ class WatchEvent:
     type: str  # ADDED | MODIFIED | DELETED
     resource: str  # plural, e.g. "pods"
     object: dict  # full object at event time (deep copy)
+    # profiling.clock() stamp at emission; the anchor for watch-to-
+    # reconcile propagation latency.  Chaos-delayed watches re-deliver
+    # the same event object, so an injected delay shows up honestly.
+    emitted_at: Optional[float] = None
 
 
 @dataclass(frozen=True)
@@ -217,7 +222,9 @@ class InMemoryAPIServer:
             raise NotFoundError("resources", resource, "unknown resource type")
 
     def _notify(self, type_: str, resource: str, obj: dict) -> None:
-        event = WatchEvent(type_, resource, copy.deepcopy(obj))
+        event = WatchEvent(
+            type_, resource, copy.deepcopy(obj), emitted_at=profiling.clock()
+        )
         for watch in list(self._watches):
             if watch.resource == resource:
                 watch._deliver(event)

@@ -22,6 +22,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from ..utils import profiling
 from .apiserver import (
     ADDED,
     DELETED,
@@ -30,6 +31,27 @@ from .apiserver import (
     InMemoryAPIServer,
     match_labels,
 )
+
+
+# -- built-in indexers --------------------------------------------------
+# client-go cache.Indexers analog: secondary key maps maintained
+# incrementally on every cache mutation, so grouped reads (pods by
+# phase, objects by namespace) cost O(groups) instead of a full scan
+# with a deep copy per object.
+
+def _namespace_of(obj: dict) -> str:
+    return (obj.get("metadata") or {}).get("namespace", "")
+
+
+def _phase_of(obj: dict) -> str:
+    # Mirrors statemetrics pod-phase semantics: no phase yet == Pending.
+    return (obj.get("status") or {}).get("phase") or "Pending"
+
+
+DEFAULT_INDEXERS: dict[str, Callable[[dict], str]] = {
+    "namespace": _namespace_of,
+    "phase": _phase_of,
+}
 
 
 def split_key(key: str) -> tuple[str, str]:
@@ -70,6 +92,16 @@ class Lister:
     ) -> list[dict]:
         return self._informer.cache_list(namespace, label_selector)
 
+    def by_index(self, index: str, value: str) -> list[dict]:
+        """Objects whose indexer maps to ``value`` (cache.Indexer.ByIndex
+        analog) — no full-cache scan."""
+        return self._informer.cache_by_index(index, value)
+
+    def index_counts(self, index: str) -> dict[str, int]:
+        """``{index value: object count}`` without copying any object —
+        the cheap path for by-phase/by-namespace gauges."""
+        return self._informer.cache_index_counts(index)
+
 
 class Informer:
     def __init__(
@@ -79,6 +111,8 @@ class Informer:
         namespace: str = "",
         resync_interval: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
+        profiler: Optional[profiling.PhaseProfiler] = None,
+        indexers: Optional[dict[str, Callable[[dict], str]]] = None,
     ):
         self._api = api
         self.resource = resource
@@ -88,8 +122,14 @@ class Informer:
         # injection) cannot leave the cache stale forever.
         self.resync_interval = resync_interval
         self._clock = clock
+        self.profiler = profiler
         self._lock = threading.RLock()
         self._cache: dict[str, dict] = {}
+        self._indexers = dict(DEFAULT_INDEXERS if indexers is None else indexers)
+        # index name -> index value -> cache keys
+        self._indexes: dict[str, dict[str, set[str]]] = {
+            name: {} for name in self._indexers
+        }
         self._handlers: list[EventHandler] = []
         self._watch = None
         self._synced = False
@@ -111,6 +151,8 @@ class Informer:
         label_selector: Optional[dict[str, str]] = None,
     ) -> list[dict]:
         with self._lock:
+            if self.profiler is not None:
+                self.profiler.record_scan(self.resource, len(self._cache))
             out = []
             for obj in self._cache.values():
                 meta = obj.get("metadata") or {}
@@ -126,6 +168,47 @@ class Informer:
                 )
             )
             return out
+
+    def cache_by_index(self, index: str, value: str) -> list[dict]:
+        with self._lock:
+            keys = self._indexes[index].get(value, ())
+            return sorted(
+                (_deep_copy(self._cache[k]) for k in keys if k in self._cache),
+                key=lambda o: (
+                    o["metadata"].get("namespace", ""),
+                    o["metadata"]["name"],
+                ),
+            )
+
+    def cache_index_counts(self, index: str) -> dict[str, int]:
+        with self._lock:
+            return {
+                value: len(keys)
+                for value, keys in self._indexes[index].items()
+                if keys
+            }
+
+    # -- index maintenance (call with self._lock held) -------------------
+
+    def _index_insert(self, key: str, obj: dict) -> None:
+        for name, indexer in self._indexers.items():
+            self._indexes[name].setdefault(indexer(obj), set()).add(key)
+
+    def _index_discard(self, key: str, obj: Optional[dict]) -> None:
+        if obj is None:
+            return
+        for name, indexer in self._indexers.items():
+            value = indexer(obj)
+            keys = self._indexes[name].get(value)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del self._indexes[name][value]
+
+    def _rebuild_indexes(self) -> None:
+        self._indexes = {name: {} for name in self._indexers}
+        for key, obj in self._cache.items():
+            self._index_insert(key, obj)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -198,6 +281,7 @@ class Informer:
                 obj for key, obj in self._cache.items() if key not in fresh
             ]
             self._cache = fresh
+            self._rebuild_indexes()
             self._synced = True
             self._need_resync = False
             self._last_sync = self._clock()
@@ -260,23 +344,36 @@ class Informer:
             key = meta_namespace_key(event.object)
             with self._lock:
                 old = self._cache.get(key)
+                self._index_discard(key, old)
                 if event.type == DELETED:
                     self._cache.pop(key, None)
                 else:
                     self._cache[key] = event.object
-            if event.type == ADDED and old is None:
-                for h in self._handlers:
-                    if h.on_add:
-                        h.on_add(_deep_copy(event.object))
-            elif event.type == DELETED:
-                for h in self._handlers:
-                    if h.on_delete:
-                        h.on_delete(_deep_copy(old if old is not None else event.object))
-            else:  # MODIFIED, or ADDED already seen via initial list
-                base = old if old is not None else event.object
-                for h in self._handlers:
-                    if h.on_update:
-                        h.on_update(_deep_copy(base), _deep_copy(event.object))
+                    self._index_insert(key, event.object)
+            if self.profiler is not None:
+                self.profiler.observe_delivery(event.emitted_at)
+            # Handlers run with the event's emission stamp visible so an
+            # enqueue they trigger can attribute the key to this event
+            # (even across object->owner key mapping).
+            profiling.set_current_event_stamp(event.emitted_at)
+            try:
+                if event.type == ADDED and old is None:
+                    for h in self._handlers:
+                        if h.on_add:
+                            h.on_add(_deep_copy(event.object))
+                elif event.type == DELETED:
+                    for h in self._handlers:
+                        if h.on_delete:
+                            h.on_delete(
+                                _deep_copy(old if old is not None else event.object)
+                            )
+                else:  # MODIFIED, or ADDED already seen via initial list
+                    base = old if old is not None else event.object
+                    for h in self._handlers:
+                        if h.on_update:
+                            h.on_update(_deep_copy(base), _deep_copy(event.object))
+            finally:
+                profiling.clear_current_event_stamp()
         return len(events)
 
     def stop(self) -> None:
@@ -299,10 +396,12 @@ class InformerFactory:
         api: InMemoryAPIServer,
         namespace: str = "",
         resync_interval: Optional[float] = None,
+        profiler: Optional[profiling.PhaseProfiler] = None,
     ):
         self._api = api
         self.namespace = namespace
         self.resync_interval = resync_interval
+        self.profiler = profiler
         self._informers: dict[str, Informer] = {}
 
     def informer(self, resource: str) -> Informer:
@@ -312,6 +411,7 @@ class InformerFactory:
                 resource,
                 namespace=self.namespace,
                 resync_interval=self.resync_interval,
+                profiler=self.profiler,
             )
         return self._informers[resource]
 

@@ -16,8 +16,9 @@ Semantics kept from client-go:
 
 Passing a ``registry`` arms the client-go workqueue metric set
 (``workqueue_depth``, ``adds_total``, ``queue_duration_seconds``,
-``work_duration_seconds``, ``retries_total``, ``unfinished_work_seconds``
-analogs), every series labeled by queue ``name``.
+``work_duration_seconds``, ``retries_total``, ``unfinished_work_seconds``,
+``longest_running_processor_seconds`` analogs), every series labeled by
+queue ``name``.
 """
 
 from __future__ import annotations
@@ -38,7 +39,7 @@ _LATENCY_BUCKETS = (
 
 
 class WorkqueueMetrics:
-    """The six client-go workqueue metrics, bound to one registry.
+    """The client-go workqueue metric set, bound to one registry.
 
     One instance can serve many queues (series split by the ``name``
     label), matching client-go's MetricsProvider shape. All clock reads
@@ -82,6 +83,14 @@ class WorkqueueMetrics:
             "tpu_operator_workqueue_unfinished_work_seconds",
             "Seconds of work in progress that has not been observed by "
             "work_duration yet (large values indicate stuck threads)",
+            ("name",),
+            registry,
+        )
+        self.longest_running = metrics.new_gauge(
+            "tpu_operator_workqueue_longest_running_processor_seconds",
+            "Seconds the single longest-running processor has held its "
+            "item (unfinished_work aggregates; this isolates one stuck "
+            "worker from many busy ones)",
             ("name",),
             registry,
         )
@@ -181,8 +190,11 @@ class RateLimitingQueue:
     def _update_unfinished_work(self) -> None:
         with self._cond:
             now = self._clock()
-            unfinished = sum(now - t for t in self._start_times.values())
-            self._metrics.unfinished_work.set(round(unfinished, 9), self.name)
+            running = [now - t for t in self._start_times.values()]
+            self._metrics.unfinished_work.set(round(sum(running), 9), self.name)
+            self._metrics.longest_running.set(
+                round(max(running, default=0.0), 9), self.name
+            )
 
     # -- core queue ------------------------------------------------------
 
@@ -293,3 +305,26 @@ class RateLimitingQueue:
     def pending_delayed(self) -> int:
         with self._cond:
             return len(self._delayed)
+
+    def stats(self) -> dict:
+        """Point-in-time queue health snapshot (the /debug/profile
+        payload): depth, in-flight work, and how long the slowest
+        processor has been holding its item.  Live values, not gauge
+        reads, so it works on unmetered queues too (durations need
+        metering — start times are only tracked then)."""
+        with self._cond:
+            now = self._clock()
+            running = [now - t for t in self._start_times.values()]
+            out = {
+                "depth": len(self._queue),
+                "delayed": len(self._delayed),
+                "processing": len(self._processing),
+                "unfinished_work_seconds": round(sum(running), 9),
+                "longest_running_processor_seconds": round(
+                    max(running, default=0.0), 9
+                ),
+            }
+            if self._metrics is not None:
+                out["adds_total"] = self._metrics.adds.value(self.name)
+                out["retries_total"] = self._metrics.retries.value(self.name)
+            return out

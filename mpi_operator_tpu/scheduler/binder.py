@@ -25,6 +25,7 @@ from typing import Callable, Optional
 
 from ..runtime import retry
 from ..runtime.apiserver import ConflictError, NotFoundError
+from ..utils import profiling
 from ..utils.logging import get_logger
 
 
@@ -52,12 +53,19 @@ class Binder:
     """Writes assignments to the API server under conflict-retry backoff
     (runtime/retry.retry_on_conflict)."""
 
-    def __init__(self, api, clock=time.time):
+    def __init__(self, api, clock=time.time, profiler=None):
         self._api = api
         self._clock = clock
+        self._profiler = profiler
         self._log = get_logger("scheduler.binder")
 
     def bind(self, namespace: str, name: str, node_name: str) -> dict:
+        if self._profiler is not None:
+            with self._profiler.phase(profiling.PHASE_SCHED_BIND):
+                return self._bind(namespace, name, node_name)
+        return self._bind(namespace, name, node_name)
+
+    def _bind(self, namespace: str, name: str, node_name: str) -> dict:
         def attempt() -> dict:
             # Each attempt re-reads the pod: a conflict means someone else
             # wrote it, so retrying the stale copy would conflict forever.
@@ -90,6 +98,12 @@ class Binder:
         condition the controller folds into the job's ``Scheduled``
         condition.  Best-effort: an unschedulable pod is untouched state,
         a write race just means another pass will repeat the verdict."""
+        if self._profiler is not None:
+            with self._profiler.phase(profiling.PHASE_SCHED_BIND):
+                return self._mark_unschedulable(namespace, name, message)
+        return self._mark_unschedulable(namespace, name, message)
+
+    def _mark_unschedulable(self, namespace: str, name: str, message: str) -> None:
         try:
             pod = self._api.get("pods", namespace, name)
         except NotFoundError:

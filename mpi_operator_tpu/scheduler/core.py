@@ -32,7 +32,7 @@ from typing import Optional
 
 from ..api.v2beta1 import constants
 from ..utils import events as ev
-from ..utils import flightrecorder, metrics
+from ..utils import flightrecorder, metrics, profiling
 from ..utils.logging import get_logger
 from .binder import Binder, BindError
 from .cache import NodeInfo, PodKey, SchedulerCache, pod_chips
@@ -118,7 +118,14 @@ class GangScheduler:
             (),
             registry,
         )
-        self.binder = binder if binder is not None else Binder(api, clock=clock)
+        # Shared with whatever else feeds this registry (the operator
+        # wires one registry through controller/manager/scheduler).
+        self.profiler = profiling.profiler_for(registry)
+        self.binder = (
+            binder
+            if binder is not None
+            else Binder(api, clock=clock, profiler=self.profiler)
+        )
         self.recorder = recorder or ev.EventRecorder(
             api, source=scheduler_name, clock=clock
         )
@@ -172,9 +179,13 @@ class GangScheduler:
 
     def _schedule_once_locked(self) -> dict:
         now = self._clock()
-        self._refresh_nodes()
-        all_pods = self.api.list("pods", None)
-        self.cache.reconcile(all_pods)
+        with self.profiler.phase(profiling.PHASE_SCHED_SNAPSHOT):
+            self._refresh_nodes()
+            all_pods = self.api.list("pods", None)
+            self.cache.reconcile(all_pods)
+        # Every pass walks the full pod store (the cost the sharded-pass
+        # ROADMAP item will attack); make it visible.
+        self.profiler.record_scan("scheduler_pods", len(all_pods))
 
         gangs = self._pending_gangs(all_pods)
         members = self._gang_sizes(all_pods)
@@ -218,6 +229,7 @@ class GangScheduler:
             (n.get("metadata") or {}).get("name", ""): n
             for n in self.api.list("nodes", None)
         }
+        self.profiler.record_scan("scheduler_nodes", len(live))
         for name in [n for n in self.cache.nodes if n not in live]:
             self.cache.remove_node(name)
         for name, node in live.items():
@@ -322,6 +334,12 @@ class GangScheduler:
     ) -> tuple[Optional[dict[PodKey, str]], TallyCounter]:
         """Reserve a node for every member, or roll back and report why
         the first unplaceable member failed on each node."""
+        with self.profiler.phase(profiling.PHASE_SCHED_RESERVE):
+            return self._assign_locked(pods)
+
+    def _assign_locked(
+        self, pods: list[dict]
+    ) -> tuple[Optional[dict[PodKey, str]], TallyCounter]:
         gang_key = gang_of(pods[0])
         ctx = SchedulingContext(
             gang_name=gang_key[1],

@@ -99,7 +99,6 @@ class StateMetrics:
         caches.  remove_matching() with an empty prefix clears all label
         sets, so deleted objects can never leave stale series behind."""
         jobs = self._job_lister.list()
-        pods = self._pod_lister.list()
 
         self.job_info.remove_matching()
         self.job_condition.remove_matching()
@@ -136,10 +135,21 @@ class StateMetrics:
             self.jobs_by_phase.set(float(job_counts.get(phase, 0)), phase)
 
         pod_counts = {phase: 0 for phase in POD_PHASES}
-        for pod in pods:
-            phase = (pod.get("status") or {}).get("phase") or "Pending"
+        for phase, count in self._pod_phase_counts().items():
             if phase not in pod_counts:
                 phase = "Unknown"
-            pod_counts[phase] += 1
+            pod_counts[phase] += count
         for phase in POD_PHASES:
             self.pods_by_phase.set(float(pod_counts.get(phase, 0)), phase)
+
+    def _pod_phase_counts(self) -> dict[str, int]:
+        """Phase counts via the informer's phase index when the lister
+        has one (O(phases), no copies); full-scan fallback keeps plain
+        list-backed listers (kube backend REST lister) working."""
+        if hasattr(self._pod_lister, "index_counts"):
+            return self._pod_lister.index_counts("phase")
+        counts: dict[str, int] = {}
+        for pod in self._pod_lister.list():
+            phase = (pod.get("status") or {}).get("phase") or "Pending"
+            counts[phase] = counts.get(phase, 0) + 1
+        return counts

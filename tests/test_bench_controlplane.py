@@ -1,0 +1,58 @@
+"""Smoke tier for the control-plane benchmark (bench_controlplane.py).
+
+The full acceptance scale (1000 jobs) runs in the ``slow`` tier; the
+tier-1 smoke keeps the harness honest on every run: a 100-job storm must
+converge on the memory backend, the emitted document must pass its own
+schema check, and the same seed must reproduce the same job outcomes.
+"""
+
+import pytest
+
+import bench_controlplane as bench
+
+
+class TestBenchSmoke:
+    def test_100_jobs_converge_and_schema_checks(self):
+        doc = bench.build_doc([100], seed=42, with_chaos=False, max_rounds=0)
+        bench.check_schema(doc)  # raises on any shape violation
+        (result,) = doc["results"]
+        assert result["converged"] is True
+        assert result["outcomes"].get("Succeeded", 0) == 100
+        assert result["jobs_per_second_to_converged"] > 0
+        shares = result["reconcile_phase_shares"]
+        assert sum(shares.values()) == pytest.approx(1.0, abs=0.05)
+        assert result["reconcile"]["p99_seconds"] >= (
+            result["reconcile"]["p50_seconds"]
+        )
+        assert result["watch_propagation"]["reconcile"]["count"] > 0
+        assert result["workqueue"]["controller"]["peak_depth"] > 0
+
+    def test_same_seed_same_outcomes(self):
+        a = bench.run_scale(60, seed=7)
+        b = bench.run_scale(60, seed=7)
+        assert a["converged"] and b["converged"]
+        assert a["outcomes"] == b["outcomes"]
+        assert a["rounds"] == b["rounds"]
+        assert a["workqueue"]["controller"]["depth_curve"] == (
+            b["workqueue"]["controller"]["depth_curve"]
+        )
+
+    def test_schema_check_rejects_missing_keys(self):
+        doc = bench.build_doc([30], seed=3, with_chaos=False, max_rounds=0)
+        del doc["results"][0]["reconcile_phase_shares"]
+        with pytest.raises(ValueError, match="reconcile_phase_shares"):
+            bench.check_schema(doc)
+
+    def test_chaos_run_still_converges(self):
+        result = bench.run_scale(40, seed=11, with_chaos=True)
+        assert result["converged"] is True
+        assert sum(result["outcomes"].values()) == 40
+        assert result["fault_counts"]  # the chaos layer actually fired
+
+
+@pytest.mark.slow
+class TestBenchAcceptanceScale:
+    def test_1000_jobs_seed_42(self):
+        result = bench.run_scale(1000, seed=42)
+        assert result["converged"] is True
+        assert sum(result["outcomes"].values()) == 1000

@@ -456,6 +456,73 @@ def test_no_swallowed_exceptions_in_control_plane():
     assert not offenders, "\n".join(offenders)
 
 
+def test_profiling_phase_names_are_canonical():
+    """The phase taxonomy is a closed vocabulary: every name registered
+    in utils/profiling.PHASES is machine-friendly (``^[a-z_]+$``), and
+    every ``.phase(...)`` call site in the package passes a string
+    literal drawn from that enum.  Free-string labels (or names computed
+    at runtime) would fragment the ``/debug/profile`` taxonomy into
+    series dashboards cannot enumerate."""
+    import ast
+    import re
+
+    from mpi_operator_tpu.utils import profiling
+
+    assert profiling.PHASES, "phase enum went missing"
+    for name in profiling.PHASES:
+        assert re.fullmatch(r"[a-z_]+", name), (
+            f"profiling phase {name!r} must match ^[a-z_]+$"
+        )
+    assert len(set(profiling.PHASES)) == len(profiling.PHASES)
+    # UNATTRIBUTED is a derived share label, never a phase name.
+    assert profiling.UNATTRIBUTED not in profiling.PHASES
+
+    offenders = []
+    for rel, line, callee, node in _package_calls():
+        if callee != "phase" or not isinstance(node.func, ast.Attribute):
+            continue
+        # The enum's home defines phase() itself (the validating
+        # constructor and the `profiled` decorator's pass-through).
+        if rel == "mpi_operator_tpu/utils/profiling.py":
+            continue
+        where = f"{rel}:{line}"
+        if not node.args:
+            offenders.append(f"{where}: .phase() with no name")
+        elif not (isinstance(node.args[0], ast.Constant)
+                  and isinstance(node.args[0].value, str)):
+            # Attribute references to the canonical constants are the
+            # sanctioned spelling (profiling.PHASE_RENDER, never a
+            # variable computed at runtime).
+            arg = node.args[0]
+            is_const_ref = (
+                isinstance(arg, ast.Attribute) and arg.attr.startswith("PHASE_")
+            ) or (isinstance(arg, ast.Name) and arg.id.startswith("PHASE_"))
+            if not is_const_ref:
+                offenders.append(
+                    f"{where}: .phase() argument must be a PHASE_* constant "
+                    "or a literal registered in profiling.PHASES"
+                )
+        elif node.args[0].value not in profiling.PHASES:
+            offenders.append(
+                f"{where}: phase {node.args[0].value!r} not registered in "
+                "profiling.PHASES"
+            )
+    assert not offenders, "\n".join(offenders)
+    # The attribution layer is actually wired through the hot paths.
+    users = {
+        rel for rel, _, callee, node in _package_calls()
+        if callee == "phase" and isinstance(node.func, ast.Attribute)
+        and rel != "mpi_operator_tpu/utils/profiling.py"
+    }
+    for expected in (
+        "mpi_operator_tpu/controller/tpu_job_controller.py",
+        "mpi_operator_tpu/scheduler/core.py",
+        "mpi_operator_tpu/scheduler/binder.py",
+        "mpi_operator_tpu/queue/manager.py",
+    ):
+        assert expected in users, f"{expected} must emit phase timings"
+
+
 def test_chaos_metrics_carry_subsystem_prefix():
     """Every metric registered under mpi_operator_tpu/chaos/ must use the
     tpu_operator_chaos_ subsystem prefix (one-matcher dashboards, like

@@ -1,0 +1,414 @@
+"""Phase-level profiling and latency attribution (utils/profiling.py).
+
+Covers the PR-6 observability layer end to end with deterministic
+clocks (the ``profiling.clock`` chokepoint — no wall-clock waits):
+
+- exclusive nested phase timing (children pause parents, shares tile);
+- the closed phase vocabulary (unknown names rejected);
+- histogram_quantile interpolation;
+- informer scan accounting and the namespace/phase index maps;
+- statemetrics pod-phase counting via the index (the scan-count drop);
+- watch-to-reconcile propagation latency with an injected delay;
+- workqueue longest_running_processor gauge and stats();
+- the /debug/profile monitoring endpoint.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from mpi_operator_tpu.cmd.operator import start_monitoring
+from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer
+from mpi_operator_tpu.runtime.informer import Informer
+from mpi_operator_tpu.runtime.workqueue import RateLimitingQueue
+from mpi_operator_tpu.utils import metrics, profiling, statemetrics
+
+
+class FakeClock:
+    """Settable monotonic clock for the profiling.clock chokepoint."""
+
+    def __init__(self, now=100.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    fake = FakeClock()
+    monkeypatch.setattr(profiling, "clock", fake)
+    return fake
+
+
+def make_pod(name, phase="Pending", namespace="default"):
+    return {
+        "metadata": {"name": name, "namespace": namespace},
+        "status": {"phase": phase} if phase else {},
+    }
+
+
+# ----------------------------------------------------------------------
+# Phase timing
+# ----------------------------------------------------------------------
+
+
+class TestPhaseTiming:
+    def test_exclusive_nested_timing(self, clock):
+        """A child phase pauses its parent: the parent is charged only
+        the time outside the child, so phases tile the pass."""
+        registry = metrics.Registry()
+        prof = profiling.PhaseProfiler(registry)
+        with prof.phase(profiling.PHASE_RENDER):
+            clock.advance(1.0)  # render alone
+            with prof.phase(profiling.PHASE_APISERVER_WRITE):
+                clock.advance(3.0)  # write (render paused)
+            clock.advance(0.5)  # render resumes
+        assert prof.phase_duration.sample_sum(profiling.PHASE_RENDER) == 1.5
+        assert (
+            prof.phase_duration.sample_sum(profiling.PHASE_APISERVER_WRITE)
+            == 3.0
+        )
+        assert prof.phase_duration.sample_count(profiling.PHASE_RENDER) == 1
+
+    def test_unknown_phase_rejected(self):
+        prof = profiling.PhaseProfiler(metrics.Registry())
+        with pytest.raises(ValueError):
+            prof.phase("made_up_phase")
+        # The derived share label is not a phase either.
+        with pytest.raises(ValueError):
+            prof.phase(profiling.UNATTRIBUTED)
+
+    def test_profiled_decorator(self, clock):
+        prof = profiling.PhaseProfiler(metrics.Registry())
+
+        @prof.profiled(profiling.PHASE_CACHE_READ)
+        def scan():
+            clock.advance(2.0)
+            return 42
+
+        assert scan() == 42
+        assert (
+            prof.phase_duration.sample_sum(profiling.PHASE_CACHE_READ) == 2.0
+        )
+
+    def test_snapshot_shares_tile_the_pass(self, clock):
+        """Reconcile phase shares plus ``unattributed`` sum to 1.0."""
+        prof = profiling.PhaseProfiler(metrics.Registry())
+        with prof.phase(profiling.PHASE_CACHE_READ):
+            clock.advance(1.0)
+        with prof.phase(profiling.PHASE_APISERVER_WRITE):
+            clock.advance(2.0)
+        prof.observe_pass(4.0)  # 1.0s of glue outside any phase
+        snap = prof.snapshot()
+        shares = snap["reconcile_phase_shares"]
+        assert shares[profiling.PHASE_CACHE_READ] == 0.25
+        assert shares[profiling.PHASE_APISERVER_WRITE] == 0.5
+        assert shares[profiling.UNATTRIBUTED] == 0.25
+        assert sum(shares.values()) == pytest.approx(1.0)
+        assert snap["reconcile"] == {"passes": 1, "seconds": 4.0}
+        # Scheduler phases never appear in reconcile shares.
+        with prof.phase(profiling.PHASE_SCHED_BIND):
+            clock.advance(9.0)
+        assert (
+            profiling.PHASE_SCHED_BIND
+            not in prof.snapshot()["reconcile_phase_shares"]
+        )
+
+    def test_profiler_for_memoizes_per_registry(self):
+        r1, r2 = metrics.Registry(), metrics.Registry()
+        assert profiling.profiler_for(r1) is profiling.profiler_for(r1)
+        assert profiling.profiler_for(r1) is not profiling.profiler_for(r2)
+
+
+class TestHistogramQuantile:
+    def test_interpolates_within_bucket(self):
+        registry = metrics.Registry()
+        hist = metrics.new_histogram(
+            "tpu_operator_test_q_seconds", "q", ("l",), registry,
+            buckets=(1.0, 2.0, 4.0),
+        )
+        for v in (0.5, 1.5, 3.0, 3.5):
+            hist.observe(v, "x")
+        # rank 2 of 4 sits at the boundary of the (1, 2] bucket.
+        assert profiling.histogram_quantile(hist, 0.5, "x") == 2.0
+        assert profiling.histogram_quantile(hist, 1.0, "x") == 4.0
+
+    def test_empty_histogram_is_zero(self):
+        registry = metrics.Registry()
+        hist = metrics.new_histogram(
+            "tpu_operator_test_q2_seconds", "q", ("l",), registry,
+            buckets=(1.0,),
+        )
+        assert profiling.histogram_quantile(hist, 0.99, "x") == 0.0
+
+
+# ----------------------------------------------------------------------
+# Informer scan accounting + index maps
+# ----------------------------------------------------------------------
+
+
+class TestInformerIndexes:
+    def _informer(self, profiler=None):
+        api = InMemoryAPIServer()
+        informer = Informer(api, "pods", profiler=profiler)
+        informer.start()
+        return api, informer
+
+    def test_cache_list_records_scan(self):
+        prof = profiling.PhaseProfiler(metrics.Registry())
+        api, informer = self._informer(prof)
+        api.create("pods", make_pod("a"))
+        api.create("pods", make_pod("b"))
+        informer.pump()
+        # start()'s initial handler dispatch already paid one listing;
+        # measure the delta from here.
+        base_passes = prof.scan_passes.value("pods")
+        base_objects = prof.scan_objects.value("pods")
+        informer.cache_list()
+        assert prof.scan_passes.value("pods") == base_passes + 1.0
+        assert prof.scan_objects.value("pods") == base_objects + 2.0
+        # The indexed paths never touch the scan counters.
+        informer.lister.by_index("phase", "Pending")
+        informer.lister.index_counts("phase")
+        assert prof.scan_passes.value("pods") == base_passes + 1.0
+
+    def test_indexes_track_watch_mutations(self):
+        api, informer = self._informer()
+        api.create("pods", make_pod("a", "Pending"))
+        api.create("pods", make_pod("b", "Running"))
+        api.create("pods", make_pod("c", "Running", namespace="other"))
+        informer.pump()
+        assert informer.lister.index_counts("phase") == {
+            "Pending": 1, "Running": 2,
+        }
+        assert informer.lister.index_counts("namespace") == {
+            "default": 2, "other": 1,
+        }
+        names = [
+            p["metadata"]["name"]
+            for p in informer.lister.by_index("phase", "Running")
+        ]
+        assert names == ["b", "c"]
+
+        # Phase transition moves the key between index buckets.
+        pod = api.get("pods", "default", "a")
+        pod["status"]["phase"] = "Running"
+        api.update_status("pods", pod)
+        informer.pump()
+        assert informer.lister.index_counts("phase") == {"Running": 3}
+
+        api.delete("pods", "default", "b")
+        informer.pump()
+        assert informer.lister.index_counts("phase") == {"Running": 2}
+        assert informer.lister.by_index("phase", "Pending") == []
+
+    def test_missing_phase_counts_as_pending(self):
+        api, informer = self._informer()
+        api.create("pods", make_pod("bare", phase=None))
+        informer.pump()
+        assert informer.lister.index_counts("phase") == {"Pending": 1}
+
+    def test_resync_rebuilds_indexes(self):
+        api, informer = self._informer()
+        api.create("pods", make_pod("a", "Running"))
+        informer.pump()
+        # Mutate behind the informer's back, then force a relist.
+        api.delete("pods", "default", "a")
+        api.create("pods", make_pod("b", "Failed"))
+        informer.resync()
+        assert informer.lister.index_counts("phase") == {"Failed": 1}
+
+
+class TestStateMetricsScanDrop:
+    def test_pod_phase_counts_use_index_not_scan(self):
+        """The satellite win: per-scrape pod-phase gauges no longer cost
+        a full cache scan — the pods scan counter stays flat across
+        scrapes while the gauges stay correct."""
+        registry = metrics.Registry()
+        prof = profiling.profiler_for(registry)
+        api = InMemoryAPIServer()
+        jobs = Informer(api, "tpujobs", profiler=prof)
+        pods = Informer(api, "pods", profiler=prof)
+        jobs.start()
+        pods.start()
+        api.create("pods", make_pod("w-0", "Running"))
+        api.create("pods", make_pod("w-1", "Running"))
+        api.create("pods", make_pod("w-2", "Failed"))
+        pods.pump()
+
+        state = statemetrics.StateMetrics(registry, jobs.lister, pods.lister)
+        base_pods = prof.scan_passes.value("pods")
+        base_jobs = prof.scan_passes.value("tpujobs")
+        for _ in range(3):
+            state.collect()
+        assert state.pods_by_phase.value("Running") == 2.0
+        assert state.pods_by_phase.value("Failed") == 1.0
+        # Three scrapes, zero pod-cache scans (index path) — while the
+        # job lister, which still lists, shows the scans it pays for.
+        assert prof.scan_passes.value("pods") == base_pods
+        assert prof.scan_passes.value("tpujobs") == base_jobs + 3.0
+
+    def test_plain_lister_fallback_still_scans(self):
+        class ListLister:
+            def list(self):
+                return [make_pod("x", "Unknown"), make_pod("y", "Running")]
+
+        registry = metrics.Registry()
+        jobs = Informer(InMemoryAPIServer(), "tpujobs")
+        jobs.start()
+        state = statemetrics.StateMetrics(registry, jobs.lister, ListLister())
+        state.collect()
+        assert state.pods_by_phase.value("Unknown") == 1.0
+        assert state.pods_by_phase.value("Running") == 1.0
+
+
+# ----------------------------------------------------------------------
+# Watch-to-reconcile latency (injected delay, no wall-clock waits)
+# ----------------------------------------------------------------------
+
+
+class TestWatchToReconcileLatency:
+    def test_injected_delay_lands_in_histograms(self, clock):
+        """Emission is stamped at create; the pump is delayed 3 simulated
+        seconds; dequeue happens 2 more seconds later.  The ``delivered``
+        and ``reconcile`` stages must observe exactly those latencies."""
+        registry = metrics.Registry()
+        prof = profiling.PhaseProfiler(registry)
+        api = InMemoryAPIServer()  # _notify stamps via profiling.clock
+        informer = Informer(api, "tpujobs", profiler=prof)
+        informer.start()
+
+        seen = []
+
+        def on_add(obj):
+            # The controller's _enqueue_obj idiom: map the event to a
+            # (possibly different) key under the current event stamp.
+            key = "default/" + obj["metadata"]["name"]
+            prof.note_event(key, profiling.current_event_stamp())
+            seen.append(key)
+
+        from mpi_operator_tpu.runtime.informer import EventHandler
+        informer.add_event_handler(EventHandler(on_add=on_add))
+
+        api.create("tpujobs", {
+            "metadata": {"name": "j", "namespace": "default"},
+        })
+        clock.advance(3.0)  # the informer pump lags emission
+        informer.pump()
+        assert seen == ["default/j"]
+        delivered = prof.watch_propagation
+        assert delivered.sample_count(profiling.STAGE_DELIVERED) == 1
+        assert delivered.sample_sum(profiling.STAGE_DELIVERED) == 3.0
+
+        clock.advance(2.0)  # the key waits in the workqueue
+        prof.observe_dequeue("default/j")
+        assert delivered.sample_count(profiling.STAGE_RECONCILE) == 1
+        assert delivered.sample_sum(profiling.STAGE_RECONCILE) == 5.0
+        # Dequeue closed the measurement; a repeat observes nothing.
+        prof.observe_dequeue("default/j")
+        assert delivered.sample_count(profiling.STAGE_RECONCILE) == 1
+
+    def test_coalesced_burst_attributes_to_earliest_event(self, clock):
+        prof = profiling.PhaseProfiler(metrics.Registry())
+        prof.note_event("k", 100.0)
+        prof.note_event("k", 103.0)  # later event coalesces into same key
+        clock.now = 110.0
+        prof.observe_dequeue("k")
+        assert (
+            prof.watch_propagation.sample_sum(profiling.STAGE_RECONCILE)
+            == 10.0
+        )
+
+    def test_stamp_is_cleared_outside_dispatch(self):
+        assert profiling.current_event_stamp() is None
+        profiling.set_current_event_stamp(1.0)
+        assert profiling.current_event_stamp() == 1.0
+        profiling.clear_current_event_stamp()
+        assert profiling.current_event_stamp() is None
+
+
+# ----------------------------------------------------------------------
+# Workqueue longest-running-processor gauge
+# ----------------------------------------------------------------------
+
+
+class TestLongestRunningProcessor:
+    def test_gauge_and_stats_isolate_slowest_worker(self):
+        fake = FakeClock()
+        registry = metrics.Registry()
+        q = RateLimitingQueue(name="sync", clock=fake, registry=registry)
+        q.add("slow")
+        q.add("fast")
+        assert q.get(timeout=0) == ("slow", False)
+        fake.advance(7.0)
+        assert q.get(timeout=0) == ("fast", False)
+        fake.advance(2.0)
+        # stats() reads live state; the gauge updates on scrape.
+        stats = q.stats()
+        assert stats["longest_running_processor_seconds"] == 9.0
+        assert stats["unfinished_work_seconds"] == 11.0
+        assert stats["processing"] == 2
+        registry.expose()  # scrape triggers the on_scrape gauge refresh
+        assert q.metrics.longest_running.value("sync") == 9.0
+        q.done("slow")
+        q.done("fast")
+        assert q.stats()["longest_running_processor_seconds"] == 0.0
+
+    def test_unmetered_queue_stats_work(self):
+        q = RateLimitingQueue(name="bare")
+        q.add("x")
+        stats = q.stats()
+        assert stats["depth"] == 1
+        assert "adds_total" not in stats
+        assert stats["longest_running_processor_seconds"] == 0.0
+
+
+# ----------------------------------------------------------------------
+# /debug/profile endpoint
+# ----------------------------------------------------------------------
+
+
+class TestDebugProfileEndpoint:
+    def test_serves_snapshot_and_workqueue_stats(self, clock):
+        registry = metrics.Registry()
+        prof = profiling.profiler_for(registry)
+        with prof.phase(profiling.PHASE_RENDER):
+            clock.advance(1.0)
+        prof.observe_pass(2.0)
+        q = RateLimitingQueue(name="sync", registry=registry)
+        q.add("pending-item")
+        server = start_monitoring(
+            0, registry, lambda: True, profiler=prof, workqueues=[q],
+        )
+        try:
+            port = server.server_address[1]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/profile", timeout=5
+            ) as resp:
+                assert resp.status == 200
+                doc = json.loads(resp.read().decode())
+        finally:
+            server.shutdown()
+        assert doc["profile"]["reconcile"] == {"passes": 1, "seconds": 2.0}
+        shares = doc["profile"]["reconcile_phase_shares"]
+        assert shares[profiling.PHASE_RENDER] == 0.5
+        assert doc["workqueues"]["sync"]["depth"] == 1
+        assert "longest_running_processor_seconds" in doc["workqueues"]["sync"]
+
+    def test_endpoint_without_profiler_is_empty(self):
+        registry = metrics.Registry()
+        server = start_monitoring(0, registry, lambda: True)
+        try:
+            port = server.server_address[1]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/profile", timeout=5
+            ) as resp:
+                doc = json.loads(resp.read().decode())
+        finally:
+            server.shutdown()
+        assert doc == {"profile": {}, "workqueues": {}}
