@@ -7,7 +7,7 @@
 #   make ci        what .github/workflows/ci.yml runs
 PYTHON ?= python3
 
-.PHONY: all native manifests verify-manifests lint image \
+.PHONY: all native manifests verify-manifests lint analyze image \
         test-kernel test-kernel-smoke test-kernel-deep test-operator \
         test test-unit test-integration test-e2e ci clean
 
@@ -40,6 +40,13 @@ lint: verify-manifests
 	@if $(PYTHON) -c 'import mypy' 2>/dev/null; then \
 	    $(PYTHON) -m mypy mpi_operator_tpu; \
 	else echo "mypy unavailable in this image (docs/round4-notes.md)"; fi
+
+# The full rule catalog (style + metric conventions + control-plane
+# hygiene + sole-writer invariants + lock discipline) with the
+# committed-baseline gate: legacy findings tracked, new findings fail.
+# See docs/static-analysis.md.
+analyze:
+	$(PYTHON) hack/analyze.py --format json --fail-on-new
 
 # Runtime base image (reference analog: Makefile:101-108 builds + e2e-
 # runs its images). Runs wherever a container runtime exists; this
@@ -100,7 +107,7 @@ test-operator:
 test:
 	$(PYTHON) -m pytest tests -q $(XDIST)
 
-ci: lint native test
+ci: lint analyze native test
 
 clean:
 	$(MAKE) -C native clean

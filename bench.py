@@ -982,16 +982,31 @@ def _startup_once(api, root) -> float:
         t0 = time.perf_counter()
         api.create("tpujobs", doc)
         elapsed = None
+        failure = None
         while time.perf_counter() - t0 < BASELINE_E2E_BOUND_S:
             job = api.get("tpujobs", "default", "pi")
             conds = (job.get("status") or {}).get("conditions") or []
             if any(c["type"] == "Succeeded" and c["status"] == "True" for c in conds):
                 elapsed = time.perf_counter() - t0
                 break
+            # A Failed job never comes back (restartPolicy Never) —
+            # surface the worker's error now instead of sleeping out
+            # the bound.
+            failed = [
+                c for c in conds
+                if c["type"] == "Failed" and c["status"] == "True"
+            ]
+            if failed:
+                failure = failed[0].get("message", "") or "(no message)"
+                break
             time.sleep(0.05)
     finally:
         stop.set()
         runner.stop()
+    if failure is not None:
+        raise RuntimeError(
+            f"pi job reached Failed instead of Succeeded: {failure[-800:]}"
+        )
     if elapsed is None:
         raise RuntimeError("pi job did not reach Succeeded within the bound")
     return elapsed

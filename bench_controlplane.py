@@ -52,7 +52,7 @@ from mpi_operator_tpu.api.v2beta1 import constants
 from mpi_operator_tpu.api.v2beta1.types import SchedulingPolicy
 from mpi_operator_tpu.controller.tpu_job_controller import TPUJobController
 from mpi_operator_tpu.queue import QueueManager, bootstrap_queues
-from mpi_operator_tpu.runtime import retry
+from mpi_operator_tpu.runtime import locktrace, retry
 from mpi_operator_tpu.runtime.apiserver import ApiError, InMemoryAPIServer
 from mpi_operator_tpu.scheduler import (
     DEFAULT_SCHEDULER_NAME,
@@ -184,6 +184,7 @@ def run_scale(
     seed: int,
     with_chaos: bool = False,
     max_rounds: int = 0,
+    lock_trace: bool = False,
 ) -> dict:
     """Drive ``jobs`` TPUJobs to terminal state; return the per-scale
     result block of the BENCH_CONTROLPLANE.json artifact."""
@@ -192,6 +193,12 @@ def run_scale(
     # set all scale sublinearly with the storm size.
     concurrency = min(64, max(8, jobs // 16))
     rng = random.Random(seed)
+
+    # The tracer must be armed before the stack below is built: locks
+    # created while tracing is off stay plain forever.
+    tracer = None
+    if lock_trace:
+        tracer = locktrace.enable(locktrace.LockTracer(capture_stacks=False))
 
     time_ = [NOW]
     clock = lambda: time_[0]  # noqa: E731
@@ -313,6 +320,10 @@ def run_scale(
     finally:
         retry.sleep = real_sleep
         scheduler.stop()
+        # Disarm the global switch; locks already created keep reporting
+        # to this tracer, so the settling sweep below is still traced.
+        if tracer is not None:
+            locktrace.disable()
 
     # Settling sweep: the manager observes the last finishes and
     # releases their quota charges.
@@ -389,6 +400,15 @@ def run_scale(
         for kind, _, _ in engine.timeline():
             fault_counts[kind] = fault_counts.get(kind, 0) + 1
         result["fault_counts"] = fault_counts
+    if tracer is not None:
+        trace_report = tracer.report()
+        result["lock_trace"] = trace_report
+        log(
+            f"lock-trace: {trace_report['acquisitions']} acquisitions "
+            f"across {len(trace_report['locks'])} locks, "
+            f"{len(trace_report['inversions'])} inversion(s), "
+            f"{len(trace_report['long_holds'])} long hold(s)"
+        )
     return result
 
 
@@ -468,14 +488,21 @@ def check_schema(doc: dict) -> None:
                     "events_per_write"):
             if key not in fanout:
                 raise ValueError(f"{where}.watch_fanout.{key}: missing")
+        # Optional: present only when the run was driven with --lock-trace.
+        if "lock_trace" in res:
+            trace = res["lock_trace"]
+            for key in ("acquisitions", "locks", "inversions", "long_holds"):
+                if key not in trace:
+                    raise ValueError(f"{where}.lock_trace.{key}: missing")
 
 
 def build_doc(scales: list[int], seed: int, with_chaos: bool,
-              max_rounds: int = 0) -> dict:
+              max_rounds: int = 0, lock_trace: bool = False) -> dict:
     results = []
     for jobs in scales:
         result = run_scale(
-            jobs, seed, with_chaos=with_chaos, max_rounds=max_rounds
+            jobs, seed, with_chaos=with_chaos, max_rounds=max_rounds,
+            lock_trace=lock_trace,
         )
         log(
             f"{jobs} jobs: converged={result['converged']} in "
@@ -507,6 +534,10 @@ def main(argv=None) -> int:
                    help="wrap the apiserver in the seeded ChaosEngine")
     p.add_argument("--max-rounds", type=int, default=0,
                    help="round budget per scale (0 = auto from storm size)")
+    p.add_argument("--lock-trace", action="store_true",
+                   help="arm the runtime lock-order tracer "
+                        "(runtime/locktrace.py) and attach its report to "
+                        "each result block")
     p.add_argument("--out", default="BENCH_CONTROLPLANE.json")
     args = p.parse_args(argv)
 
@@ -514,7 +545,8 @@ def main(argv=None) -> int:
     # the bench's own stderr narration is the signal here.
     logutil.configure(level=logutil.parse_level("warning"))
     scales = [int(s) for s in args.jobs.split(",") if s.strip()]
-    doc = build_doc(scales, args.seed, args.chaos, args.max_rounds)
+    doc = build_doc(scales, args.seed, args.chaos, args.max_rounds,
+                    lock_trace=args.lock_trace)
     check_schema(doc)
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=2, sort_keys=True)

@@ -1,0 +1,100 @@
+#!/usr/bin/env python3
+"""Run the registered analyzer rules over the repo (golangci-lint-style
+driver for ``mpi_operator_tpu/analysis``).
+
+Usage:
+
+    hack/analyze.py                       # text report, all rules
+    hack/analyze.py --format json         # machine-readable report
+    hack/analyze.py --fail-on-new         # exit 1 on non-baselined findings
+    hack/analyze.py --select TPU4         # one rule family (prefix match)
+    hack/analyze.py --update-baseline     # re-snapshot legacy findings
+    hack/analyze.py --list-rules          # the rule catalog
+
+The committed baseline (``hack/analysis_baseline.json``) tracks legacy
+findings by ``rule|file|message`` key so they stay visible without
+failing CI; anything beyond the baselined count is "new" and fails
+``--fail-on-new`` (the ``make analyze`` / CI mode).  Suppress a single
+site with ``# noqa: TPUxxx`` — see docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from mpi_operator_tpu.analysis import framework  # noqa: E402
+
+DEFAULT_BASELINE = REPO / "hack" / "analysis_baseline.json"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--fail-on-new", action="store_true",
+                        help="exit 1 when findings exceed the baseline")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from current findings")
+    parser.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE)
+    parser.add_argument("--select", default="",
+                        help="comma-separated rule-ID prefixes (TPU4,TPU101)")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--root", type=Path, default=REPO)
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for r in framework.all_rules():
+            alias = framework.LEGACY_ALIASES.get(r.id)
+            alias_txt = f" (alias {alias})" if alias else ""
+            print(f"{r.id}{alias_txt}  {r.name}: {r.description}")
+        return 0
+
+    select = [s.strip() for s in args.select.split(",") if s.strip()] or None
+    repo = framework.RepoView(args.root)
+    findings = framework.run(repo, select=select)
+
+    if args.update_baseline:
+        # The baseline always snapshots the FULL rule set — a selected
+        # subset would silently drop every other family's legacy keys.
+        if select:
+            findings = framework.run(repo)
+        framework.write_baseline(args.baseline, findings)
+        print(f"baseline: wrote {len(findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    baseline = framework.load_baseline(args.baseline)
+    fresh = framework.new_findings(findings, baseline)
+    syntax = [f for f in findings
+              if f.rule_id == framework.SYNTAX_RULE_ID]
+
+    if args.format == "json":
+        print(json.dumps({
+            "files": len(repo.files),
+            "rules": [r.id for r in framework.all_rules()],
+            "findings": [f.to_dict() for f in findings],
+            "new": [f.to_dict() for f in fresh],
+            "baselined": len(findings) - len(fresh),
+            "baseline": str(args.baseline),
+        }, indent=2))
+    else:
+        for f in findings:
+            marker = "NEW " if f in fresh else "base"
+            print(f"[{marker}] {f.render()}")
+        print(f"analyze: {len(repo.files)} files, {len(findings)} "
+              f"finding(s), {len(fresh)} new vs baseline")
+
+    if syntax:
+        return 1
+    if args.fail_on_new and fresh:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

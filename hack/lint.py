@@ -1,197 +1,54 @@
 #!/usr/bin/env python3
-"""Self-contained static-analysis tier (reference analog: the
-golangci-lint workflow, /root/reference/.github/workflows/lint.yml).
+"""Style-tier lint shim over ``mpi_operator_tpu/analysis``.
 
-No third-party linter ships in this image, so the checks that matter
-for this codebase are implemented directly on ``ast``:
+The five AST checks that used to live here (F401/B006/E722/F541/F811)
+are now registered analyzer rules TPU001–TPU005 in
+``mpi_operator_tpu/analysis/rules.py``; this shim keeps the historic
+``check_file(path) -> list[str]`` API and flake8-style message format
+(``file:line: F401 'os' imported but unused``) so ``make lint`` and
+editor integrations keep working unchanged.  Both the legacy codes and
+the TPU IDs are honoured in ``# noqa:`` comments.
 
-- F401 unused imports (``__init__.py`` re-exports and ``__all__``
-  entries are exempt — re-exporting IS their use)
-- B006 mutable default arguments (list/dict/set/call literals)
-- E722 bare ``except:``
-- F541 f-strings without any placeholder
-- F811 redefinition of a name already bound by a def/class in the same
-  scope (shadowed dead code), decorator-aware (@overload/@property
-  setters are legitimate redefinitions)
-- W605 invalid escape sequences are promoted to errors by compileall
-  (``-W error::SyntaxWarning``), which ``make lint`` runs first
-
-Exit status 1 with file:line diagnostics when anything trips.
+The full rule catalog (metric conventions, control-plane hygiene,
+sole-writer invariants, lock discipline) runs via ``hack/analyze.py``.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-ROOTS = ["mpi_operator_tpu", "sdk", "hack", "tests",
-         "bench.py", "__graft_entry__.py", "conftest.py"]
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
 
-MUTABLE_NODES = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
-                 ast.SetComp)
+from mpi_operator_tpu.analysis import framework  # noqa: E402
+from mpi_operator_tpu.analysis.rules import style_findings  # noqa: E402
 
-
-def _names_loaded(tree: ast.AST) -> set[str]:
-    """Every identifier the module reads (including attribute roots and
-    names referenced inside string annotations is out of scope — the
-    codebase uses ``from __future__ import annotations`` sparingly and
-    imports used only in annotations are rare and exempted by # noqa)."""
-    used: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            root = node
-            while isinstance(root, ast.Attribute):
-                root = root.value
-            if isinstance(root, ast.Name):
-                used.add(root.id)
-    return used
-
-
-def _exported(tree: ast.AST) -> set[str]:
-    out: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "__all__":
-                    if isinstance(node.value, (ast.List, ast.Tuple)):
-                        for elt in node.value.elts:
-                            if isinstance(elt, ast.Constant) and isinstance(
-                                    elt.value, str):
-                                out.add(elt.value)
-    return out
+ROOTS = framework.REPO_ROOTS
 
 
 def check_file(path: Path) -> list[str]:
-    src = path.read_text()
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as e:
+    path = Path(path)
+    sf = framework.SourceFile(path, str(path))
+    if sf.tree is None and sf.syntax_error is not None:
+        e = sf.syntax_error
         return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
-    errs: list[str] = []
-    lines = src.splitlines()
-
-    def noqa(lineno: int, code: str = "") -> bool:
-        """flake8 semantics: bare ``# noqa`` suppresses everything on
-        the line; ``# noqa: X1,X2`` suppresses only the listed codes."""
-        if not 0 < lineno <= len(lines):
-            return False
-        line = lines[lineno - 1]
-        idx = line.find("# noqa")
-        if idx < 0:
-            return False
-        rest = line[idx + len("# noqa"):]
-        if not rest.lstrip().startswith(":"):
-            return True  # blanket suppression
-        listed = rest.lstrip()[1:].split(",")
-        return code in {c.strip() for c in listed}
-
-    # --- F401 unused imports ------------------------------------------
-    is_init = path.name == "__init__.py"
-    used = _names_loaded(tree)
-    exported = _exported(tree)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                bound = (a.asname or a.name).split(".")[0]
-                if (not is_init and bound not in used
-                        and bound not in exported and not noqa(node.lineno, "F401")):
-                    errs.append(
-                        f"{path}:{node.lineno}: F401 '{a.name}' imported "
-                        f"but unused"
-                    )
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "__future__":
-                continue
-            for a in node.names:
-                if a.name == "*":
-                    continue
-                bound = a.asname or a.name
-                # In __init__.py an import IS the export surface; an
-                # explicit ``x as x`` alias is the PEP-484 re-export
-                # idiom elsewhere.
-                reexport = is_init or (a.asname is not None
-                                       and a.asname == a.name)
-                if (bound not in used and bound not in exported
-                        and not reexport and not noqa(node.lineno, "F401")):
-                    errs.append(
-                        f"{path}:{node.lineno}: F401 '{a.name}' imported "
-                        f"but unused"
-                    )
-
-    # Format specs ({x:.1f}) parse as nested JoinedStr nodes with no
-    # FormattedValue of their own — they are not f-strings to flag.
-    spec_ids = {
-        id(n.format_spec)
-        for n in ast.walk(tree)
-        if isinstance(n, ast.FormattedValue) and n.format_spec is not None
-    }
-
-    for node in ast.walk(tree):
-        # --- B006 mutable defaults ------------------------------------
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            defaults = list(node.args.defaults) + [
-                d for d in node.args.kw_defaults if d is not None
-            ]
-            for d in defaults:
-                if isinstance(d, MUTABLE_NODES) and not noqa(d.lineno, "B006"):
-                    errs.append(
-                        f"{path}:{d.lineno}: B006 mutable default "
-                        f"argument in {node.name}()"
-                    )
-        # --- E722 bare except -----------------------------------------
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            if not noqa(node.lineno, "E722"):
-                errs.append(f"{path}:{node.lineno}: E722 bare 'except:'")
-        # --- F541 f-string without placeholders -----------------------
-        if isinstance(node, ast.JoinedStr) and id(node) not in spec_ids:
-            if not any(isinstance(v, ast.FormattedValue)
-                       for v in node.values) and not noqa(node.lineno, "F541"):
-                errs.append(
-                    f"{path}:{node.lineno}: F541 f-string without any "
-                    f"placeholders"
-                )
-
-    # --- F811 redefinition in the same scope --------------------------
-    def scope_check(body: list, where: str) -> None:
-        seen: dict[str, tuple[int, set]] = {}
-        for stmt in body:
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.ClassDef)):
-                decos = {
-                    d.id if isinstance(d, ast.Name)
-                    else d.attr if isinstance(d, ast.Attribute) else ""
-                    for d in getattr(stmt, "decorator_list", [])
-                }
-                legit = decos & {"overload", "setter", "deleter", "getter",
-                                 "register", "property"}
-                prev = seen.get(stmt.name)
-                # The undecorated implementation after @overload stubs is
-                # the pattern working as intended (pyflakes exempts it by
-                # remembering the PRIOR binding's decorators).
-                prev_overload = prev is not None and "overload" in prev[1]
-                if (prev is not None and not legit and not prev_overload
-                        and not noqa(stmt.lineno, "F811")):
-                    errs.append(
-                        f"{path}:{stmt.lineno}: F811 redefinition of "
-                        f"'{stmt.name}' (first defined at line {prev[0]}) "
-                        f"in {where}"
-                    )
-                seen[stmt.name] = (stmt.lineno, decos)
-                scope_check(stmt.body, f"'{stmt.name}'")
-
-    scope_check(tree.body, "module scope")
+    errs = []
+    for f in sorted(style_findings(sf)):
+        if sf.noqa(f.line, f.rule_id):
+            continue
+        code = framework.LEGACY_ALIASES.get(f.rule_id, f.rule_id)
+        errs.append(f"{path}:{f.line}: {code} {f.message}")
     return errs
 
 
 def main() -> int:
-    base = Path(__file__).resolve().parent.parent
     errs: list[str] = []
     n_files = 0
     for root in ROOTS:
-        p = base / root
+        p = REPO / root
+        if not p.exists():
+            continue
         files = [p] if p.suffix == ".py" else sorted(p.rglob("*.py"))
         for f in files:
             if "__pycache__" in f.parts:

@@ -1,0 +1,16 @@
+"""Static analysis for the operator's control plane.
+
+Three layers, one rule registry:
+
+- :mod:`framework` — ``Finding``/``Rule``/``RepoView`` plumbing, the
+  ``# noqa`` contract (rule IDs plus the legacy flake8 aliases), and
+  the committed-baseline workflow (``hack/analysis_baseline.json``).
+- :mod:`rules` — the style tier migrated out of ``hack/lint.py``
+  (TPU001–TPU005), Prometheus naming conventions (TPU1xx), control-
+  plane hygiene (TPU2xx), and the sole-writer invariants (TPU3xx).
+- :mod:`lockcheck` — the lock-discipline checker (TPU4xx): inferred
+  attribute guards and the cross-module lock-ordering graph.
+
+Run it all via ``hack/analyze.py`` (or ``make analyze``); the runtime
+counterpart is :mod:`mpi_operator_tpu.runtime.locktrace`.
+"""

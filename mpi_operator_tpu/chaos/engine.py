@@ -16,7 +16,6 @@ interleaving.
 from __future__ import annotations
 
 import random
-import threading
 from dataclasses import dataclass
 from typing import Optional
 
@@ -26,6 +25,7 @@ from ..runtime.apiserver import (
     ServerError,
     ServerTimeoutError,
 )
+from ..runtime import locktrace
 from ..utils.metrics import Registry, new_counter
 from .policy import ChaosPolicy, PodChaos
 
@@ -59,7 +59,7 @@ class ChaosEngine:
         self.policy = policy
         self.seed = policy.seed
         self._rng = random.Random(policy.seed)
-        self._lock = threading.Lock()
+        self._lock = locktrace.lock("chaos.engine")
         self._events: list[ChaosEvent] = []
         self._kill_counts: dict[int, int] = {}
         self.faults_total = new_counter(

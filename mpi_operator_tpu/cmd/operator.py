@@ -34,6 +34,7 @@ from typing import Optional
 
 from ..api.v2beta1 import constants
 from ..controller.tpu_job_controller import TPUJobController
+from ..runtime import locktrace
 from ..runtime.apiserver import InMemoryAPIServer, NotFoundError
 from ..runtime.leaderelection import LeaderElectionConfig, LeaderElector
 from ..runtime.podrunner import LocalPodRunner
@@ -112,6 +113,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="maximum burst for apiserver client throttle")
     p.add_argument("--apply", action="append", default=[],
                    help="TPUJob YAML file(s) to apply at startup")
+    p.add_argument("--lock-trace", action="store_true",
+                   help="arm the runtime lock-order race detector "
+                        "(runtime/locktrace.py); equivalent to "
+                        f"{locktrace.ENV_FLAG}=1")
     p.add_argument("--exit-on-completion", action="store_true",
                    help="exit once every applied TPUJob is finished")
     p.add_argument("--version", action="version", version=version_string())
@@ -270,11 +275,36 @@ def _ua() -> str:
     return VERSION
 
 
+def _emit_lock_trace_report() -> None:
+    """On shutdown, summarize the lock-order graph when tracing is armed
+    (via --lock-trace or the environment flag)."""
+    t = locktrace.tracer()
+    if t is None:
+        return
+    report = t.report()
+    print(
+        f"lock-trace: {report['acquisitions']} acquisitions across "
+        f"{len(report['locks'])} locks, "
+        f"{len(report['inversions'])} inversion(s), "
+        f"{len(report['long_holds'])} long hold(s)",
+        file=sys.stderr,
+    )
+    for inv in report["inversions"]:
+        print(
+            f"lock-trace inversion: {inv['forward']} vs {inv['reverse']}",
+            file=sys.stderr,
+        )
+
+
 def run(argv=None) -> int:
     args = build_parser().parse_args(argv)
     logutil.configure(
         level=logutil.parse_level(args.log_level), format=args.log_format
     )
+    if args.lock_trace and not locktrace.enabled():
+        # Before any backend/controller construction: locks created while
+        # tracing is off stay plain forever.
+        locktrace.enable()
     if args.enable_scheduler and args.backend != "memory":
         print(
             "--enable-scheduler requires --backend memory (a real cluster "
@@ -495,6 +525,7 @@ def run(argv=None) -> int:
                         scheduler.stop()
                     if runner is not None:
                         runner.stop()
+                    _emit_lock_trace_report()
                     return 0 if all(f["type"] == "Succeeded" for _, _, f in finals) else 1
             time.sleep(poll_interval)
     except KeyboardInterrupt:
@@ -503,6 +534,7 @@ def run(argv=None) -> int:
         scheduler.stop()
     if runner is not None:
         runner.stop()
+    _emit_lock_trace_report()
     return 0
 
 

@@ -52,7 +52,7 @@ from ..api.v2beta1.types import (
     TPUJob,
 )
 from ..controller import status as st
-from ..runtime import retry
+from ..runtime import locktrace, retry
 from ..runtime.apiserver import (
     AlreadyExistsError,
     ConflictError,
@@ -111,7 +111,7 @@ class QueueManager:
         self.tpujobs = TPUJobClient(api)
         self.clock = clock
         self.log = logutil.get_logger("queue-manager")
-        self._lock = threading.RLock()
+        self._lock = locktrace.rlock("queue.manager")
         self._resync_interval = resync_interval
         self._priorities = dict(DEFAULT_PRIORITIES if priorities is None else priorities)
 

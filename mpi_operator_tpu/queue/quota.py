@@ -17,8 +17,9 @@ tests/test_queue.py.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass, field
+
+from ..runtime import locktrace
 from typing import Dict, Iterable, List, Optional, Tuple
 
 # (namespace, name) of the admitted TPUJob.
@@ -62,7 +63,7 @@ class QuotaLedger:
     """Usage accounting for a set of ClusterQueues, cohort-aware."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = locktrace.rlock("queue.quota")
         self._queues: Dict[str, _QueueEntry] = {}
         self._charges: Dict[JobKey, Charge] = {}
         # (queue, generation) -> admitted chips, kept incrementally.

@@ -24,13 +24,13 @@ from __future__ import annotations
 
 import copy
 import itertools
-import threading
 import time
 import uuid
 from dataclasses import dataclass
 from typing import Callable, Optional
 
 from ..utils import profiling
+from . import locktrace
 from ..utils.logging import DEBUG, get_logger
 
 
@@ -144,7 +144,7 @@ class Watch:
         self.resource = resource
         self.namespace = namespace  # None = cluster-wide
         self._events: list[WatchEvent] = []
-        self._cond = threading.Condition()
+        self._cond = locktrace.condition("apiserver.watch")
         self._stopped = False
 
     def _deliver(self, event: WatchEvent) -> None:
@@ -195,7 +195,7 @@ class InMemoryAPIServer:
     """Thread-safe in-memory object store with Kubernetes semantics."""
 
     def __init__(self, clock: Callable[[], float] = time.time):
-        self._lock = threading.RLock()
+        self._lock = locktrace.rlock("apiserver.store")
         self._clock = clock
         self._log = get_logger("apiserver")
         self._rv = itertools.count(1)
@@ -241,7 +241,10 @@ class InMemoryAPIServer:
             )
 
     def clear_actions(self) -> None:
-        self.actions.clear()
+        # Writers append via _record() under self._lock; clearing must
+        # take the same lock or it races an in-flight write (TPU401).
+        with self._lock:
+            self.actions.clear()
 
     # -- CRUD ------------------------------------------------------------
 

@@ -17,12 +17,12 @@ which is exactly what the reference's deep-copy-before-mutate discipline
 from __future__ import annotations
 
 import copy
-import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
 from ..utils import profiling
+from . import locktrace
 from .apiserver import (
     ADDED,
     DELETED,
@@ -123,7 +123,7 @@ class Informer:
         self.resync_interval = resync_interval
         self._clock = clock
         self.profiler = profiler
-        self._lock = threading.RLock()
+        self._lock = locktrace.rlock(f"informer.{resource}")
         self._cache: dict[str, dict] = {}
         self._indexers = dict(DEFAULT_INDEXERS if indexers is None else indexers)
         # index name -> index value -> cache keys
@@ -213,7 +213,24 @@ class Informer:
     # -- lifecycle -------------------------------------------------------
 
     def add_event_handler(self, handler: EventHandler) -> None:
-        self._handlers.append(handler)
+        # The pump loop runs on its own thread: registration must not
+        # race an in-flight handler iteration (list.append is atomic in
+        # CPython, but the guarded/unguarded split is exactly what the
+        # TPU401 checker bans — one discipline everywhere).
+        with self._lock:
+            self._handlers.append(handler)
+
+    def _handlers_snapshot(self) -> list[EventHandler]:
+        """Handlers as of now; iterate the snapshot so delivery never
+        holds the cache lock and never races add_event_handler."""
+        with self._lock:
+            return list(self._handlers)
+
+    def set_resync_interval(self, seconds: Optional[float]) -> None:
+        """Arm/change the reflector resync period (pump reads it under
+        the lock; a cross-thread bare-attribute write would race)."""
+        with self._lock:
+            self.resync_interval = seconds
 
     def _in_scope(self, obj: dict) -> bool:
         return not self.namespace or (obj.get("metadata") or {}).get(
@@ -285,13 +302,15 @@ class Informer:
             self._synced = True
             self._need_resync = False
             self._last_sync = self._clock()
-        # Handlers fire outside the lock.
+        # Handlers fire outside the lock (a snapshot: registration may
+        # race the relist).
+        handlers = self._handlers_snapshot()
         for obj in removed:
-            for h in self._handlers:
+            for h in handlers:
                 if h.on_delete:
                     h.on_delete(_deep_copy(obj))
         for obj in self.cache_list():
-            for h in self._handlers:
+            for h in handlers:
                 if h.on_add:
                     h.on_add(obj)
 
@@ -307,18 +326,23 @@ class Informer:
         which handlers still see — the workqueue dedups, as in client-go.
         """
         # Snapshot under the lock: stop() may null the watch concurrently
-        # (the pump loop is not joined before stop_all at step-down).
+        # (the pump loop is not joined before stop_all at step-down), and
+        # _last_sync/_synced/resync_interval are written by resync() and
+        # set_resync_interval() on other threads.
         with self._lock:
             watch = self._watch
             stale = self._need_resync
+            synced = self._synced
+            if not stale and self.resync_interval is not None:
+                stale = (
+                    self._clock() - self._last_sync >= self.resync_interval
+                )
         if watch is None:
-            if not self._synced:
+            if not synced:
                 raise RuntimeError(
                     f"informer for {self.resource} not started; call start() first"
                 )
             return 0  # started, then stopped: clean shutdown
-        if not stale and self.resync_interval is not None:
-            stale = self._clock() - self._last_sync >= self.resync_interval
         if stale:
             with self._lock:
                 self._need_resync = True  # sticky until a relist succeeds
@@ -330,6 +354,7 @@ class Informer:
                 watch = self._watch
             if watch is None:
                 return 0
+        handlers = self._handlers_snapshot()
         try:
             events = watch.drain()
         except GoneError:
@@ -358,18 +383,18 @@ class Informer:
             profiling.set_current_event_stamp(event.emitted_at)
             try:
                 if event.type == ADDED and old is None:
-                    for h in self._handlers:
+                    for h in handlers:
                         if h.on_add:
                             h.on_add(_deep_copy(event.object))
                 elif event.type == DELETED:
-                    for h in self._handlers:
+                    for h in handlers:
                         if h.on_delete:
                             h.on_delete(
                                 _deep_copy(old if old is not None else event.object)
                             )
                 else:  # MODIFIED, or ADDED already seen via initial list
                     base = old if old is not None else event.object
-                    for h in self._handlers:
+                    for h in handlers:
                         if h.on_update:
                             h.on_update(_deep_copy(base), _deep_copy(event.object))
             finally:
@@ -420,7 +445,7 @@ class InformerFactory:
         chaos harness arm resync on a controller-owned factory)."""
         self.resync_interval = seconds
         for informer in self._informers.values():
-            informer.resync_interval = seconds
+            informer.set_resync_interval(seconds)
 
     def start_all(self) -> None:
         for informer in self._informers.values():

@@ -33,7 +33,7 @@ from typing import Optional
 from ..api.v2beta1 import constants
 from ..utils import flightrecorder
 from ..utils.logging import get_logger
-from . import retry
+from . import locktrace, retry
 from .apiserver import (
     ADDED,
     DELETED,
@@ -83,7 +83,7 @@ class LocalPodRunner:
         self.node_name = node_name
         self._pods: dict[tuple[str, str], RunningPod] = {}
         self._job_pods: dict[tuple[str, str], int] = {}  # job -> failures so far
-        self._lock = threading.RLock()
+        self._lock = locktrace.rlock("podrunner")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._pod_watch = None

@@ -24,11 +24,11 @@ queue ``name``.
 from __future__ import annotations
 
 import heapq
-import threading
 import time
 from typing import Any, Hashable, Optional
 
 from ..utils import metrics
+from . import locktrace
 
 # Queue/work latencies span informer-event microseconds up to multi-second
 # syncs against a real apiserver: wider-than-default buckets at both ends
@@ -103,7 +103,7 @@ class ItemExponentialFailureRateLimiter:
         self.base_delay = base_delay
         self.max_delay = max_delay
         self._failures: dict[Hashable, int] = {}
-        self._lock = threading.Lock()
+        self._lock = locktrace.lock("workqueue.ratelimiter")
 
     def when(self, item: Hashable) -> float:
         with self._lock:
@@ -133,7 +133,7 @@ class RateLimitingQueue:
         self.name = name
         self._rate_limiter = rate_limiter or ItemExponentialFailureRateLimiter()
         self._clock = clock
-        self._cond = threading.Condition()
+        self._cond = locktrace.condition(f"workqueue.{name or 'default'}")
         self._queue: list[Any] = []  # FIFO of ready items
         self._queued: set[Hashable] = set()  # dedup: in _queue or delayed
         self._processing: set[Hashable] = set()
@@ -311,20 +311,31 @@ class RateLimitingQueue:
         payload): depth, in-flight work, and how long the slowest
         processor has been holding its item.  Live values, not gauge
         reads, so it works on unmetered queues too (durations need
-        metering — start times are only tracked then)."""
+        metering — start times are only tracked then).
+
+        All mutable state is copied in ONE critical section — a single
+        consistent cut of the queue — and the derived math plus the
+        metric-counter reads (which take the metrics' own locks) happen
+        after release, keeping the condition's hold time flat no matter
+        how many processors are in flight.
+        """
         with self._cond:
             now = self._clock()
-            running = [now - t for t in self._start_times.values()]
-            out = {
-                "depth": len(self._queue),
-                "delayed": len(self._delayed),
-                "processing": len(self._processing),
-                "unfinished_work_seconds": round(sum(running), 9),
-                "longest_running_processor_seconds": round(
-                    max(running, default=0.0), 9
-                ),
-            }
-            if self._metrics is not None:
-                out["adds_total"] = self._metrics.adds.value(self.name)
-                out["retries_total"] = self._metrics.retries.value(self.name)
-            return out
+            depth = len(self._queue)
+            delayed = len(self._delayed)
+            processing = len(self._processing)
+            start_times = list(self._start_times.values())
+        running = [now - t for t in start_times]
+        out = {
+            "depth": depth,
+            "delayed": delayed,
+            "processing": processing,
+            "unfinished_work_seconds": round(sum(running), 9),
+            "longest_running_processor_seconds": round(
+                max(running, default=0.0), 9
+            ),
+        }
+        if self._metrics is not None:
+            out["adds_total"] = self._metrics.adds.value(self.name)
+            out["retries_total"] = self._metrics.retries.value(self.name)
+        return out

@@ -34,6 +34,7 @@ from ..api.v2beta1 import constants
 from ..utils import events as ev
 from ..utils import flightrecorder, metrics, profiling
 from ..utils.logging import get_logger
+from ..runtime import locktrace
 from .binder import Binder, BindError
 from .cache import NodeInfo, PodKey, SchedulerCache, pod_chips
 from .plugins import (
@@ -118,6 +119,13 @@ class GangScheduler:
             (),
             registry,
         )
+        self.chips = metrics.new_gauge(
+            "tpu_operator_scheduler_chips",
+            "TPU chips in the scheduler cache by accounting state "
+            "(capacity, allocated, reserved, free).",
+            ("state",),
+            registry,
+        )
         # Shared with whatever else feeds this registry (the operator
         # wires one registry through controller/manager/scheduler).
         self.profiler = profiling.profiler_for(registry)
@@ -136,12 +144,27 @@ class GangScheduler:
         self.cache = SchedulerCache()
         self._clock = clock
         self._interval = interval
-        self._lock = threading.RLock()
+        self._lock = locktrace.rlock("scheduler.core")
         self._first_seen: dict[tuple[str, str], float] = {}
         self._wait_expired: set[tuple[str, str]] = set()
         self._last_failure_msg: dict[tuple[str, str], str] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # The cache is only safe under the scheduling lock; scrapes happen
+        # on the metrics server's thread, so the pull-model hook takes the
+        # lock for one consistent cut of the chip ledger.
+        registry.on_scrape(self._update_chip_gauges)
+
+    def _update_chip_gauges(self) -> None:
+        with self._lock:
+            totals = {
+                "capacity": self.cache.total_capacity(),
+                "allocated": self.cache.total_allocated(),
+                "reserved": self.cache.total_reserved(),
+                "free": self.cache.total_free(),
+            }
+        for state, value in totals.items():
+            self.chips.set(value, state)
 
     # -- lifecycle --------------------------------------------------------
 

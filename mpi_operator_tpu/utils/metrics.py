@@ -227,6 +227,15 @@ class Histogram(_Metric):
             series = self._series.get(labels)
             return series.count if series else 0
 
+    def sample_stats(self, *labels: str) -> tuple[int, float]:
+        """(count, sum) from ONE lock acquisition.  Reading the two
+        separate accessors back-to-back can pair a newer count with an
+        older sum when an observe lands between them — callers deriving
+        means or shares need the consistent pair."""
+        with self._lock:
+            series = self._series.get(labels)
+            return (series.count, series.sum) if series else (0, 0.0)
+
     def cumulative_counts(self, *labels: str) -> list[int]:
         """Bucket counts as exposed: cumulative, last entry == count."""
         with self._lock:

@@ -44,6 +44,7 @@ import time
 import weakref
 from typing import Callable, Optional
 
+from ..runtime import locktrace
 from . import metrics
 
 # ----------------------------------------------------------------------
@@ -114,12 +115,19 @@ def clear_current_event_stamp() -> None:
 
 
 def histogram_quantile(
-    hist: metrics.Histogram, q: float, *labels: str
+    hist: metrics.Histogram, q: float, *labels: str,
+    counts: Optional[list[int]] = None,
 ) -> float:
     """PromQL ``histogram_quantile`` analog: linear interpolation within
     the bucket containing the rank.  Observations in the +Inf bucket
-    report the largest finite bound (same clamping Prometheus applies)."""
-    counts = hist.cumulative_counts(*labels)
+    report the largest finite bound (same clamping Prometheus applies).
+
+    Pass ``counts`` (a ``cumulative_counts`` result) to compute several
+    quantiles from one atomic read of the histogram instead of a fresh
+    — possibly shifted — read per quantile.
+    """
+    if counts is None:
+        counts = hist.cumulative_counts(*labels)
     total = counts[-1] if counts else 0
     if total == 0:
         return 0.0
@@ -206,7 +214,7 @@ class PhaseProfiler:
             registry,
             buckets=LATENCY_BUCKETS,
         )
-        self._lock = threading.Lock()
+        self._lock = locktrace.lock("profiler")
         self._local = threading.local()
         self._pass_count = 0
         self._pass_seconds = 0.0
@@ -309,13 +317,13 @@ class PhaseProfiler:
 
         phases: dict[str, dict] = {}
         for name in PHASES:
-            count = self.phase_duration.sample_count(name)
+            # One atomic (count, sum) pair per phase: separate accessor
+            # calls can tear under concurrent observes (count from after
+            # an observe paired with the sum from before it).
+            count, seconds = self.phase_duration.sample_stats(name)
             if count == 0:
                 continue
-            phases[name] = {
-                "count": count,
-                "seconds": self.phase_duration.sample_sum(name),
-            }
+            phases[name] = {"count": count, "seconds": seconds}
 
         reconcile_attributed = sum(
             phases[name]["seconds"]
@@ -333,16 +341,19 @@ class PhaseProfiler:
 
         propagation: dict[str, dict] = {}
         for stage in PROPAGATION_STAGES:
-            count = self.watch_propagation.sample_count(stage)
+            # One cumulative read per stage; count and both quantiles
+            # derive from the same cut of the histogram.
+            counts = self.watch_propagation.cumulative_counts(stage)
+            count = counts[-1] if counts else 0
             if count == 0:
                 continue
             propagation[stage] = {
                 "count": count,
                 "p50_seconds": histogram_quantile(
-                    self.watch_propagation, 0.50, stage
+                    self.watch_propagation, 0.50, stage, counts=counts
                 ),
                 "p99_seconds": histogram_quantile(
-                    self.watch_propagation, 0.99, stage
+                    self.watch_propagation, 0.99, stage, counts=counts
                 ),
             }
 

@@ -1,0 +1,470 @@
+"""Concurrency analyzer tests: the rule framework (noqa, baselines,
+select), the static lock-discipline checks (TPU401 unguarded mutation,
+TPU402 lock-order inversion), the runtime lock-order tracer
+(runtime/locktrace.py), and the repo-wide gate that replaces the old
+test_lint.py sweeps.
+
+Fixture contract for the cross-class checks (documented in
+docs/static-analysis.md): the checker resolves ``self.x.m()`` calls only
+when ``self.x`` is assigned a direct constructor call (``self.x = B()``)
+or an annotated ``__init__`` parameter (``def __init__(self, b:
+Optional["B"])``).  Fixtures below follow that contract.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+import threading
+from pathlib import Path
+
+import pytest
+
+from mpi_operator_tpu.analysis import framework, lockcheck
+from mpi_operator_tpu.runtime import locktrace
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+BASELINE = REPO_ROOT / "hack" / "analysis_baseline.json"
+
+
+def view(tmp_path, source: str, name: str = "mod.py") -> framework.RepoView:
+    (tmp_path / name).write_text(textwrap.dedent(source))
+    return framework.RepoView(tmp_path, roots=[name])
+
+
+# ----------------------------------------------------------------------
+# Framework: findings, noqa, select, baseline
+# ----------------------------------------------------------------------
+
+
+class TestFramework:
+    def test_baseline_key_is_line_independent(self):
+        a = framework.Finding("m.py", 3, "TPU101", "bad name")
+        b = framework.Finding("m.py", 40, "TPU101", "bad name")
+        assert a.baseline_key == b.baseline_key
+        assert a.render() == "m.py:3: TPU101 bad name"
+
+    def test_new_findings_are_excess_over_baselined_count(self):
+        f = [framework.Finding("m.py", i, "TPU201", "print") for i in (1, 2, 3)]
+        baseline = {f[0].baseline_key: 2}
+        fresh = framework.new_findings(f, baseline)
+        assert len(fresh) == 1  # two baselined, third is new
+        # A shrunk count is progress, not drift.
+        assert framework.new_findings(f[:1], baseline) == []
+
+    def test_baseline_roundtrip(self, tmp_path):
+        f = [framework.Finding("m.py", 1, "TPU201", "print")] * 2
+        path = tmp_path / "b.json"
+        framework.write_baseline(path, f)
+        loaded = framework.load_baseline(path)
+        assert loaded == {f[0].baseline_key: 2}
+        assert json.loads(path.read_text())["version"] == 1
+
+    def test_blanket_noqa_suppresses_everything(self, tmp_path):
+        repo = view(tmp_path, "import os  # noqa\n")
+        sf = repo.file("mod.py")
+        assert sf.noqa(1, "TPU001")
+        assert sf.noqa(1, "TPU999")
+
+    def test_coded_noqa_matches_id_and_legacy_alias(self, tmp_path):
+        repo = view(
+            tmp_path,
+            "import os  # noqa: F401\nimport sys  # noqa: TPU001\n"
+            "import json  # noqa: E722\n",
+        )
+        sf = repo.file("mod.py")
+        assert sf.noqa(1, "TPU001")  # legacy flake8 alias still honoured
+        assert sf.noqa(2, "TPU001")  # native ID
+        assert not sf.noqa(3, "TPU001")  # a different code is not blanket
+        kept = framework.run(repo, select=["TPU001"])
+        assert [(f.line, f.message) for f in kept] == [
+            (3, "'json' imported but unused")
+        ]
+
+    def test_syntax_error_becomes_tpu000(self, tmp_path):
+        repo = view(tmp_path, "def broken(:\n")
+        findings = framework.run(repo)
+        assert [f.rule_id for f in findings] == ["TPU000"]
+        # Syntax errors always fail the CLI regardless of baseline.
+
+    def test_select_prefix_filters_rule_families(self, tmp_path):
+        repo = view(tmp_path, "import os\nprint('hi')\n")
+        ids = {f.rule_id for f in framework.run(repo, select=["TPU0"])}
+        assert ids == {"TPU001"}
+
+    def test_rule_registry_has_stable_ids(self):
+        ids = [r.id for r in framework.all_rules()]
+        assert ids == sorted(ids) and len(ids) == len(set(ids))
+        for required in ("TPU001", "TPU110", "TPU301", "TPU302", "TPU303",
+                         "TPU401", "TPU402"):
+            assert required in ids
+
+
+# ----------------------------------------------------------------------
+# Static lock checks: TPU401 / TPU402 on seeded fixtures
+# ----------------------------------------------------------------------
+
+
+UNGUARDED = """
+    import threading
+
+    class Tracker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def add(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def clear(self):
+            self._items = []
+"""
+
+GUARDED_VIA_PRIVATE_HELPER = """
+    import threading
+
+    class Tracker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def add(self, x):
+            with self._lock:
+                self._push(x)
+
+        def _push(self, x):
+            self._items.append(x)
+"""
+
+REENTRANT = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self._n = 0
+
+        def bump(self):
+            with self._lock:
+                self.bump_twice()
+
+        def bump_twice(self):
+            with self._lock:
+                self._n += 1
+"""
+
+INVERSION = """
+    import threading
+    from typing import Optional
+
+    class A:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.b = B()
+
+        def go(self):
+            with self._lock:
+                pass
+
+        def forward(self):
+            with self._lock:
+                self.b.poke()
+
+    class B:
+        def __init__(self, a: Optional["A"] = None):
+            self._lock = threading.Lock()
+            self.a = a
+
+        def poke(self):
+            with self._lock:
+                pass
+
+        def reverse(self):
+            with self._lock:
+                self.a.go()
+"""
+
+
+class TestLockcheck:
+    def test_seeded_unguarded_mutation_is_found(self, tmp_path):
+        repo = view(tmp_path, UNGUARDED)
+        findings = lockcheck.guard_findings(lockcheck.build_model(repo))
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.rule_id == "TPU401"
+        assert "'_items'" in f.message and "clear()" in f.message
+        # And through the registered rule path:
+        assert [x.rule_id for x in framework.run(repo, select=["TPU4"])] == [
+            "TPU401"
+        ]
+
+    def test_private_helper_inherits_callers_guard(self, tmp_path):
+        repo = view(tmp_path, GUARDED_VIA_PRIVATE_HELPER)
+        assert lockcheck.guard_findings(lockcheck.build_model(repo)) == []
+
+    def test_init_writes_are_exempt(self, tmp_path):
+        # __init__ assigns _items with no lock held; not a finding.
+        repo = view(tmp_path, GUARDED_VIA_PRIVATE_HELPER)
+        model = lockcheck.build_model(repo)
+        assert "Tracker" in model
+        assert lockcheck.guard_findings(model) == []
+
+    def test_reentrant_rlock_is_not_an_inversion(self, tmp_path):
+        repo = view(tmp_path, REENTRANT)
+        model = lockcheck.build_model(repo)
+        assert lockcheck.guard_findings(model) == []
+        assert lockcheck.inversion_findings(model) == []
+
+    def test_seeded_lock_order_inversion_is_found(self, tmp_path):
+        repo = view(tmp_path, INVERSION)
+        findings = lockcheck.inversion_findings(lockcheck.build_model(repo))
+        assert len(findings) == 1
+        msg = findings[0].message
+        assert findings[0].rule_id == "TPU402"
+        assert "A._lock" in msg and "B._lock" in msg
+        assert "deadlock" in msg
+
+    def test_never_guarded_attribute_is_not_flagged(self, tmp_path):
+        # Plain unshared state next to a lock used for something else.
+        repo = view(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._scratch = None
+
+                def set(self, x):
+                    self._scratch = x
+
+                def touch(self, x):
+                    self._scratch = [x]
+        """)
+        assert lockcheck.guard_findings(lockcheck.build_model(repo)) == []
+
+    def test_locktrace_factories_count_as_lock_ctors(self, tmp_path):
+        repo = view(tmp_path, """
+            from mpi_operator_tpu.runtime import locktrace
+
+            class C:
+                def __init__(self):
+                    self._lock = locktrace.lock("c")
+                    self._items = []
+
+                def add(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def clear(self):
+                    self._items = []
+        """)
+        findings = lockcheck.guard_findings(lockcheck.build_model(repo))
+        assert [f.rule_id for f in findings] == ["TPU401"]
+
+
+# ----------------------------------------------------------------------
+# Runtime tracer
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture()
+def traced():
+    tracer = locktrace.enable(locktrace.LockTracer(capture_stacks=False))
+    yield tracer
+    locktrace.disable()
+
+
+class TestLockTracer:
+    def test_factories_return_plain_primitives_when_off(self):
+        assert not locktrace.enabled()
+        assert isinstance(locktrace.lock("x"), type(threading.Lock()))
+        assert not isinstance(locktrace.rlock("x"), locktrace.TracedRLock)
+        assert isinstance(locktrace.condition("x"), threading.Condition)
+
+    def test_factories_return_traced_primitives_when_armed(self, traced):
+        assert isinstance(locktrace.lock("x"), locktrace.TracedLock)
+        assert isinstance(locktrace.rlock("x"), locktrace.TracedRLock)
+        cond = locktrace.condition("x")
+        assert isinstance(cond, threading.Condition)
+        assert isinstance(cond._lock, locktrace.TracedRLock)
+
+    def test_locks_created_before_enable_stay_plain(self):
+        before = locktrace.lock("early")
+        tracer = locktrace.enable(locktrace.LockTracer(capture_stacks=False))
+        try:
+            with before:
+                pass
+            assert tracer.report()["acquisitions"] == 0
+        finally:
+            locktrace.disable()
+
+    def test_consistent_order_records_edges_not_inversions(self, traced):
+        a, b = locktrace.lock("a"), locktrace.lock("b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        report = traced.report()
+        assert report["edges"] == {"a": ["b"]}
+        assert report["inversions"] == []
+        traced.assert_no_inversions()
+
+    def test_inversion_is_detected_without_deadlocking(self, traced):
+        a, b = locktrace.lock("a"), locktrace.lock("b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        report = traced.report()
+        assert len(report["inversions"]) == 1
+        inv = report["inversions"][0]
+        assert inv["locks"] == ["a", "b"]
+        with pytest.raises(locktrace.LockOrderError) as exc:
+            traced.assert_no_inversions()
+        assert "a -> b" in str(exc.value)
+
+    def test_inversion_pair_reported_once(self, traced):
+        a, b = locktrace.lock("a"), locktrace.lock("b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+        assert len(traced.report()["inversions"]) == 1
+
+    def test_same_name_is_one_lock_class(self, traced):
+        # Two instances sharing a name: ordering between them is still an
+        # inversion (lockdep's lock-class idiom)...
+        first, second = locktrace.lock("informer"), locktrace.lock("informer")
+        with first:
+            with second:
+                pass
+        # ...except A->A self-edges, which read as reentrancy, not order.
+        assert traced.report()["inversions"] == []
+        assert traced.report()["edges"] == {}
+
+    def test_rlock_reentry_reports_only_outermost(self, traced):
+        outer = locktrace.lock("outer")
+        r = locktrace.rlock("r")
+        with outer:
+            with r:
+                with r:  # re-entry: must not add edges again
+                    pass
+        report = traced.report()
+        assert report["edges"] == {"outer": ["r"]}
+        assert report["inversions"] == []
+
+    def test_condition_wait_releases_held_set(self, traced):
+        cond = locktrace.condition("cond")
+        done = threading.Event()
+
+        def waker():
+            with cond:
+                cond.notify_all()
+            done.set()
+
+        with cond:
+            threading.Timer(0.01, waker).start()
+            cond.wait(timeout=2.0)
+        done.wait(timeout=2.0)
+        report = traced.report()
+        # wait() dropped and re-took the lock; the held-set stayed honest:
+        # the waker thread's acquisition created no edge from "cond".
+        assert report["inversions"] == []
+        assert traced.held_names() == ()
+
+    def test_long_hold_detection_with_fake_clock(self):
+        time_ = [0.0]
+        tracer = locktrace.LockTracer(
+            clock=lambda: time_[0], long_hold_seconds=5.0,
+            capture_stacks=False,
+        )
+        lk = locktrace.TracedLock("slow", tracer)
+        with lk:
+            time_[0] += 9.0
+        report = tracer.report()
+        assert len(report["long_holds"]) == 1
+        assert report["long_holds"][0]["lock"] == "slow"
+        assert report["long_holds"][0]["held_seconds"] == 9.0
+        assert report["max_held_seconds"]["slow"] == 9.0
+
+    def test_held_names_tracks_nesting(self, traced):
+        a, b = locktrace.lock("a"), locktrace.lock("b")
+        with a:
+            with b:
+                assert traced.held_names() == ("a", "b")
+            assert traced.held_names() == ("a",)
+        assert traced.held_names() == ()
+
+    def test_cross_thread_inversion_detected(self, traced):
+        a, b = locktrace.lock("a"), locktrace.lock("b")
+        with a:
+            with b:
+                pass
+
+        def other():
+            with b:
+                with a:
+                    pass
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join(timeout=5)
+        assert len(traced.report()["inversions"]) == 1
+
+
+# ----------------------------------------------------------------------
+# Repo gate: the analyzer replaces the old test_lint.py sweeps
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def repo_findings():
+    repo = framework.RepoView(REPO_ROOT)
+    return framework.run(repo)
+
+
+class TestRepoGate:
+    def test_repo_has_no_new_findings(self, repo_findings):
+        baseline = framework.load_baseline(BASELINE)
+        fresh = framework.new_findings(repo_findings, baseline)
+        assert fresh == [], "\n".join(
+            ["new analyzer findings (fix, # noqa, or --update-baseline):"]
+            + [f.render() for f in fresh]
+        )
+
+    def test_repo_has_no_syntax_errors(self, repo_findings):
+        assert [f for f in repo_findings if f.rule_id == "TPU000"] == []
+
+    def test_baseline_has_no_stale_entries(self, repo_findings):
+        """Every baselined debt item still exists — a fixed finding must
+        leave the baseline (run hack/analyze.py --update-baseline)."""
+        baseline = framework.load_baseline(BASELINE)
+        current: dict[str, int] = {}
+        for f in repo_findings:
+            current[f.baseline_key] = current.get(f.baseline_key, 0) + 1
+        stale = {
+            key: count - current.get(key, 0)
+            for key, count in baseline.items()
+            if current.get(key, 0) < count
+        }
+        assert stale == {}, f"baseline entries no longer observed: {stale}"
+
+    def test_analyze_cli_json_is_clean(self):
+        proc = subprocess.run(
+            [sys.executable, "hack/analyze.py", "--format", "json",
+             "--fail-on-new"],
+            cwd=REPO_ROOT, capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["new"] == []
+        assert doc["files"] > 100
+        assert "TPU402" in doc["rules"]

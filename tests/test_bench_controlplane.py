@@ -49,6 +49,27 @@ class TestBenchSmoke:
         assert sum(result["outcomes"].values()) == 40
         assert result["fault_counts"]  # the chaos layer actually fired
 
+    def test_lock_trace_run_reports_zero_inversions(self):
+        """--lock-trace analog: the run converges with the runtime
+        lock-order tracer armed, attaches its report to the result block
+        (schema-checked), and the control-plane order graph shows zero
+        inversions."""
+        from mpi_operator_tpu.runtime import locktrace
+
+        result = bench.run_scale(40, seed=5, lock_trace=True)
+        assert not locktrace.enabled()  # the harness disarms on exit
+        assert result["converged"] is True
+        trace = result["lock_trace"]
+        assert trace["acquisitions"] > 1000
+        assert len(trace["locks"]) >= 5
+        assert trace["inversions"] == []
+        doc = {
+            "benchmark": "controlplane",
+            "schema_version": bench.SCHEMA_VERSION,
+            "results": [result],
+        }
+        bench.check_schema(doc)  # lock_trace block passes the schema gate
+
 
 @pytest.mark.slow
 class TestBenchAcceptanceScale:

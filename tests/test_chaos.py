@@ -45,7 +45,7 @@ from mpi_operator_tpu.controller import builders
 from mpi_operator_tpu.controller import status as st
 from mpi_operator_tpu.controller.tpu_job_controller import TPUJobController
 from mpi_operator_tpu.queue import QueueManager, bootstrap_queues
-from mpi_operator_tpu.runtime import retry
+from mpi_operator_tpu.runtime import locktrace, retry
 from mpi_operator_tpu.runtime.apiserver import (
     ApiError,
     ConflictError,
@@ -675,6 +675,29 @@ class TestChaosSoak:
         # And a different seed produces a different fault sequence.
         other = run_soak(seed=99)
         assert other["timeline"] != first["timeline"]
+
+    def test_soak_runs_with_zero_lock_order_inversions(self):
+        """The runtime race detector (runtime/locktrace.py), armed across
+        a full chaos soak: every control-plane lock acquisition is
+        recorded, the lock-order graph is non-trivial, and no pair of
+        locks was ever taken in both orders (the deadlock precondition).
+        Tracing must be armed BEFORE the stack is built — locks created
+        while it is off stay plain."""
+        tracer = locktrace.enable(
+            locktrace.LockTracer(capture_stacks=False)
+        )
+        try:
+            result = run_soak(seed=42)
+        finally:
+            locktrace.disable()
+        assert result["rounds"] is not None, "traced soak did not converge"
+        report = tracer.report()
+        # The soak exercised real nesting, not an idle graph.
+        assert report["acquisitions"] > 1000
+        assert len(report["locks"]) >= 5
+        assert any(report["edges"].values())
+        assert report["inversions"] == []
+        tracer.assert_no_inversions()
 
 
 # ----------------------------------------------------------------------

@@ -43,6 +43,11 @@ def cluster():
 
 
 def wait_for_condition(api, name, cond_type, timeout=FOREVER_TIMEOUT):
+    # A job sitting in the *other* terminal state will never reach
+    # cond_type — bail immediately with its message instead of sleeping
+    # out the full bound (matters when the environment cannot run the
+    # workload at all: the diagnostic surfaces in seconds, not minutes).
+    terminal = {"Succeeded", "Failed"} - {cond_type}
     deadline = time.time() + timeout
     while time.time() < deadline:
         try:
@@ -50,9 +55,16 @@ def wait_for_condition(api, name, cond_type, timeout=FOREVER_TIMEOUT):
         except Exception:
             job = None
         if job:
-            for c in (job.get("status") or {}).get("conditions") or []:
+            conds = (job.get("status") or {}).get("conditions") or []
+            for c in conds:
                 if c["type"] == cond_type and c["status"] == "True":
                     return job
+            for c in conds:
+                if c["type"] in terminal and c["status"] == "True":
+                    raise AssertionError(
+                        f"{name} reached terminal {c['type']} while waiting "
+                        f"for {cond_type}: {c.get('message', '')[-500:]}"
+                    )
         time.sleep(0.2)
     raise AssertionError(f"timed out waiting for {name} to reach {cond_type}")
 

@@ -1,5 +1,10 @@
-"""The AST lint tier (hack/lint.py): catches the defect classes it
-advertises, stays quiet on clean code, and the repo itself is clean."""
+"""The lint shim (hack/lint.py): catches the defect classes it
+advertises with flake8-style codes and stays quiet on clean code.
+
+The repo-wide sweeps that used to live here (metric naming, sole
+writers, hygiene) are registered analyzer rules now — see
+mpi_operator_tpu/analysis/rules.py and the single gate in
+tests/test_analysis.py::TestRepoGate::test_repo_has_no_new_findings."""
 
 import subprocess
 import sys
@@ -102,78 +107,6 @@ def test_repo_is_clean():
     assert out.returncode == 0, out.stdout[-2000:]
 
 
-def _registered_metric_names():
-    """(file, lineno, kind, name) for every literal metric registration
-    (new_counter/new_gauge/new_histogram call) in the package source."""
-    import ast
-
-    pkg = Path(__file__).resolve().parent.parent / "mpi_operator_tpu"
-    found = []
-    for path in sorted(pkg.rglob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = node.func
-            callee = (
-                fn.id if isinstance(fn, ast.Name)
-                else fn.attr if isinstance(fn, ast.Attribute)
-                else ""
-            )
-            if callee not in ("new_counter", "new_gauge", "new_histogram"):
-                continue
-            if not (node.args and isinstance(node.args[0], ast.Constant)
-                    and isinstance(node.args[0].value, str)):
-                continue
-            found.append(
-                (path.relative_to(pkg.parent), node.lineno, callee,
-                 node.args[0].value)
-            )
-    return found
-
-
-def test_metric_naming_conventions():
-    """Prometheus naming: one namespace prefix for the whole operator,
-    counters end in _total, histograms (base unit: seconds) in _seconds."""
-    registrations = _registered_metric_names()
-    assert len(registrations) >= 10, "metric registrations went missing"
-    bad = []
-    for file, line, kind, name in registrations:
-        where = f"{file}:{line} {kind}({name!r})"
-        if not name.startswith("tpu_operator_"):
-            bad.append(f"{where}: missing tpu_operator_ prefix")
-        if kind == "new_counter" and not name.endswith("_total"):
-            bad.append(f"{where}: counter must end in _total")
-        if kind == "new_histogram" and not name.endswith("_seconds"):
-            bad.append(f"{where}: histogram must end in _seconds")
-    assert not bad, "\n".join(bad)
-
-
-def test_scheduler_metrics_carry_subsystem_prefix():
-    """Every metric registered under mpi_operator_tpu/scheduler/ must use
-    the tpu_operator_scheduler_ subsystem prefix (so dashboards can
-    select the scheduler's series with one matcher), and the scheduler
-    must register its whole advertised quartet."""
-    scheduler_metrics = [
-        (file, line, kind, name)
-        for file, line, kind, name in _registered_metric_names()
-        if str(file).replace("\\", "/").startswith("mpi_operator_tpu/scheduler/")
-    ]
-    assert scheduler_metrics, "scheduler metric registrations went missing"
-    bad = [
-        f"{file}:{line} {kind}({name!r}): missing tpu_operator_scheduler_ prefix"
-        for file, line, kind, name in scheduler_metrics
-        if not name.startswith("tpu_operator_scheduler_")
-    ]
-    assert not bad, "\n".join(bad)
-    names = {name for _, _, _, name in scheduler_metrics}
-    assert {
-        "tpu_operator_scheduler_scheduling_duration_seconds",
-        "tpu_operator_scheduler_pending_gangs",
-        "tpu_operator_scheduler_binds_total",
-        "tpu_operator_scheduler_preemptions_total",
-    } <= names
-
 
 def test_scheduler_plugins_expose_framework_interface():
     """Every concrete plugin in scheduler/plugins.py must carry the
@@ -206,341 +139,3 @@ def test_scheduler_plugins_expose_framework_interface():
     # The default pipeline is built from these plugins.
     assert {p.name for p in plugin_mod.DEFAULT_PLUGINS} <= names
 
-
-def test_queue_metrics_carry_subsystem_prefix():
-    """Every metric registered under mpi_operator_tpu/queue/ must use the
-    tpu_operator_queue_ subsystem prefix (one-matcher dashboards, like
-    the scheduler), and the queue must register its advertised quartet."""
-    queue_metrics = [
-        (file, line, kind, name)
-        for file, line, kind, name in _registered_metric_names()
-        if str(file).replace("\\", "/").startswith("mpi_operator_tpu/queue/")
-    ]
-    assert queue_metrics, "queue metric registrations went missing"
-    bad = [
-        f"{file}:{line} {kind}({name!r}): missing tpu_operator_queue_ prefix"
-        for file, line, kind, name in queue_metrics
-        if not name.startswith("tpu_operator_queue_")
-    ]
-    assert not bad, "\n".join(bad)
-    names = {name for _, _, _, name in queue_metrics}
-    assert {
-        "tpu_operator_queue_pending_workloads",
-        "tpu_operator_queue_admitted_workloads",
-        "tpu_operator_queue_admission_duration_seconds",
-        "tpu_operator_queue_evictions_total",
-    } <= names
-
-
-def test_suspend_writes_confined_to_queue_package():
-    """While the admission queue is enabled the QueueManager is the single
-    writer of ``runPolicy.suspend`` — a second writer elsewhere in the
-    operator would fight it (admit/evict flapping).  Enforced at the AST
-    level: no assignment targets ``.suspend`` / ``["suspend"]`` outside
-    mpi_operator_tpu/queue/, except the API types' own (de)serialization."""
-    import ast
-
-    allowed_prefixes = (
-        "mpi_operator_tpu/queue/",
-        # The dataclass's field definition and to_dict/from_dict round-trip.
-        "mpi_operator_tpu/api/v2beta1/types.py",
-    )
-
-    def writes_suspend(target) -> bool:
-        if isinstance(target, ast.Attribute) and target.attr == "suspend":
-            return True
-        if (isinstance(target, ast.Subscript)
-                and isinstance(target.slice, ast.Constant)
-                and target.slice.value == "suspend"):
-            return True
-        if isinstance(target, (ast.Tuple, ast.List)):
-            return any(writes_suspend(e) for e in target.elts)
-        return False
-
-    pkg = Path(__file__).resolve().parent.parent / "mpi_operator_tpu"
-    offenders = []
-    for path in sorted(pkg.rglob("*.py")):
-        rel = str(path.relative_to(pkg.parent)).replace("\\", "/")
-        if rel.startswith(allowed_prefixes[0]) or rel == allowed_prefixes[1]:
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            targets = []
-            if isinstance(node, ast.Assign):
-                targets = node.targets
-            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
-                targets = [node.target]
-            for target in targets:
-                if writes_suspend(target):
-                    offenders.append(
-                        f"{rel}:{node.lineno}: suspend write outside queue/"
-                    )
-    assert not offenders, "\n".join(offenders)
-
-
-def _package_calls():
-    """(relpath, lineno, callee-name, node) for every Call in the package
-    source, where callee-name is the bare function or attribute name."""
-    import ast
-
-    pkg = Path(__file__).resolve().parent.parent / "mpi_operator_tpu"
-    for path in sorted(pkg.rglob("*.py")):
-        rel = path.relative_to(pkg.parent)
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = node.func
-            callee = (
-                fn.id if isinstance(fn, ast.Name)
-                else fn.attr if isinstance(fn, ast.Attribute)
-                else ""
-            )
-            yield str(rel).replace("\\", "/"), node.lineno, callee, node
-
-
-def test_no_bare_print_outside_cmd():
-    """Operator/runtime/scheduler code logs through the structured logger
-    (or emit_json for machine-readable line protocols); bare print() is
-    only legitimate in the cmd/ entrypoints, whose stdout IS the UI."""
-    offenders = [
-        f"{rel}:{line}: print() outside cmd/"
-        for rel, line, callee, _ in _package_calls()
-        if callee == "print" and not rel.startswith("mpi_operator_tpu/cmd/")
-    ]
-    assert not offenders, "\n".join(offenders)
-
-
-def test_loggers_come_from_structured_logging():
-    """Every logger handle comes from utils/logging.get_logger — stdlib
-    logging.getLogger would bypass the process-global sink (level/format
-    flags, trace_id attachment) and fragment the log stream."""
-    offenders = [
-        f"{rel}:{line}: logging.getLogger() bypasses utils/logging"
-        for rel, line, callee, _ in _package_calls()
-        if callee == "getLogger" and rel != "mpi_operator_tpu/utils/logging.py"
-    ]
-    assert not offenders, "\n".join(offenders)
-    # The sanctioned constructor is actually in use across the layers.
-    users = {
-        rel for rel, _, callee, _ in _package_calls() if callee == "get_logger"
-    }
-    for expected in (
-        "mpi_operator_tpu/controller/tpu_job_controller.py",
-        "mpi_operator_tpu/scheduler/core.py",
-        "mpi_operator_tpu/runtime/podrunner.py",
-        "mpi_operator_tpu/launcher/bootstrap.py",
-    ):
-        assert expected in users, f"{expected} must use get_logger"
-
-
-def _registered_gauges_with_labels():
-    """(file, lineno, name, label-names-or-None) for every literal
-    new_gauge registration; labels is None when not a literal tuple."""
-    import ast
-
-    found = []
-    for rel, line, callee, node in _package_calls():
-        if callee != "new_gauge":
-            continue
-        if not (node.args and isinstance(node.args[0], ast.Constant)
-                and isinstance(node.args[0].value, str)):
-            continue
-        labels_node = node.args[2] if len(node.args) > 2 else None
-        if labels_node is None:
-            for kw in node.keywords:
-                if kw.arg == "label_names":
-                    labels_node = kw.value
-        labels = None
-        if labels_node is None:
-            labels = ()
-        elif isinstance(labels_node, (ast.Tuple, ast.List)) and all(
-            isinstance(e, ast.Constant) and isinstance(e.value, str)
-            for e in labels_node.elts
-        ):
-            labels = tuple(e.value for e in labels_node.elts)
-        found.append((rel, line, node.args[0].value, labels))
-    return found
-
-
-def test_gauge_naming_conventions():
-    """kube-state-metrics idiom: gauges never end in _total (that suffix
-    promises a counter), _info gauges carry identity as labels (constant
-    value 1 means the labels ARE the payload), and by_phase gauges
-    declare the phase label they enumerate."""
-    gauges = _registered_gauges_with_labels()
-    assert len(gauges) >= 5, "gauge registrations went missing"
-    bad = []
-    for file, line, name, labels in gauges:
-        where = f"{file}:{line} new_gauge({name!r})"
-        if name.endswith("_total"):
-            bad.append(f"{where}: _total suffix promises a counter")
-        if name.endswith("_info") and labels is not None and not labels:
-            bad.append(f"{where}: _info gauge needs identity labels")
-        if "by_phase" in name and labels is not None and "phase" not in labels:
-            bad.append(f"{where}: by_phase gauge must declare a phase label")
-    assert not bad, "\n".join(bad)
-    names = {name for _, _, name, _ in gauges}
-    # The state-metric family itself is registered.
-    assert {
-        "tpu_operator_job_info",
-        "tpu_operator_jobs_by_phase",
-        "tpu_operator_pods_by_phase",
-        "tpu_operator_job_condition",
-    } <= names
-
-
-# Control-plane packages: writers that must stay responsive and honest
-# under fault injection (the chaos tier exercises exactly these paths).
-_CONTROL_PLANE_PREFIXES = (
-    "mpi_operator_tpu/controller/",
-    "mpi_operator_tpu/scheduler/",
-    "mpi_operator_tpu/queue/",
-)
-
-
-def test_no_bare_sleep_in_control_plane():
-    """Control-plane code never calls time.sleep directly: every pause
-    goes through runtime/retry.sleep (backoff delays and pump-loop idles
-    alike), the single monkeypatchable chokepoint that lets the chaos
-    soak and unit tests collapse wall-clock waits to zero."""
-    import ast
-
-    offenders = []
-    for rel, line, callee, node in _package_calls():
-        if callee != "sleep":
-            continue
-        if not rel.startswith(_CONTROL_PLANE_PREFIXES):
-            continue
-        fn = node.func
-        bare_name = isinstance(fn, ast.Name)  # `from time import sleep`
-        time_attr = (
-            isinstance(fn, ast.Attribute)
-            and isinstance(fn.value, ast.Name)
-            and fn.value.id == "time"
-        )
-        if bare_name or time_attr:
-            offenders.append(
-                f"{rel}:{line}: bare sleep() — use runtime/retry.sleep"
-            )
-    assert not offenders, "\n".join(offenders)
-
-
-def test_no_swallowed_exceptions_in_control_plane():
-    """``except Exception: pass`` in controller/scheduler/queue silently
-    eats the very faults the chaos tier injects (a conflict or 500
-    vanishing instead of being retried or surfaced).  Handlers must
-    log, re-raise, or narrow the exception type."""
-    import ast
-
-    pkg = Path(__file__).resolve().parent.parent / "mpi_operator_tpu"
-    offenders = []
-    for path in sorted(pkg.rglob("*.py")):
-        rel = str(path.relative_to(pkg.parent)).replace("\\", "/")
-        if not rel.startswith(_CONTROL_PLANE_PREFIXES):
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
-            broad = node.type is None or (
-                isinstance(node.type, ast.Name)
-                and node.type.id in ("Exception", "BaseException")
-            )
-            silent = all(isinstance(stmt, ast.Pass) for stmt in node.body)
-            if broad and silent:
-                offenders.append(
-                    f"{rel}:{node.lineno}: except Exception: pass swallows "
-                    "injected faults"
-                )
-    assert not offenders, "\n".join(offenders)
-
-
-def test_profiling_phase_names_are_canonical():
-    """The phase taxonomy is a closed vocabulary: every name registered
-    in utils/profiling.PHASES is machine-friendly (``^[a-z_]+$``), and
-    every ``.phase(...)`` call site in the package passes a string
-    literal drawn from that enum.  Free-string labels (or names computed
-    at runtime) would fragment the ``/debug/profile`` taxonomy into
-    series dashboards cannot enumerate."""
-    import ast
-    import re
-
-    from mpi_operator_tpu.utils import profiling
-
-    assert profiling.PHASES, "phase enum went missing"
-    for name in profiling.PHASES:
-        assert re.fullmatch(r"[a-z_]+", name), (
-            f"profiling phase {name!r} must match ^[a-z_]+$"
-        )
-    assert len(set(profiling.PHASES)) == len(profiling.PHASES)
-    # UNATTRIBUTED is a derived share label, never a phase name.
-    assert profiling.UNATTRIBUTED not in profiling.PHASES
-
-    offenders = []
-    for rel, line, callee, node in _package_calls():
-        if callee != "phase" or not isinstance(node.func, ast.Attribute):
-            continue
-        # The enum's home defines phase() itself (the validating
-        # constructor and the `profiled` decorator's pass-through).
-        if rel == "mpi_operator_tpu/utils/profiling.py":
-            continue
-        where = f"{rel}:{line}"
-        if not node.args:
-            offenders.append(f"{where}: .phase() with no name")
-        elif not (isinstance(node.args[0], ast.Constant)
-                  and isinstance(node.args[0].value, str)):
-            # Attribute references to the canonical constants are the
-            # sanctioned spelling (profiling.PHASE_RENDER, never a
-            # variable computed at runtime).
-            arg = node.args[0]
-            is_const_ref = (
-                isinstance(arg, ast.Attribute) and arg.attr.startswith("PHASE_")
-            ) or (isinstance(arg, ast.Name) and arg.id.startswith("PHASE_"))
-            if not is_const_ref:
-                offenders.append(
-                    f"{where}: .phase() argument must be a PHASE_* constant "
-                    "or a literal registered in profiling.PHASES"
-                )
-        elif node.args[0].value not in profiling.PHASES:
-            offenders.append(
-                f"{where}: phase {node.args[0].value!r} not registered in "
-                "profiling.PHASES"
-            )
-    assert not offenders, "\n".join(offenders)
-    # The attribution layer is actually wired through the hot paths.
-    users = {
-        rel for rel, _, callee, node in _package_calls()
-        if callee == "phase" and isinstance(node.func, ast.Attribute)
-        and rel != "mpi_operator_tpu/utils/profiling.py"
-    }
-    for expected in (
-        "mpi_operator_tpu/controller/tpu_job_controller.py",
-        "mpi_operator_tpu/scheduler/core.py",
-        "mpi_operator_tpu/scheduler/binder.py",
-        "mpi_operator_tpu/queue/manager.py",
-    ):
-        assert expected in users, f"{expected} must emit phase timings"
-
-
-def test_chaos_metrics_carry_subsystem_prefix():
-    """Every metric registered under mpi_operator_tpu/chaos/ must use the
-    tpu_operator_chaos_ subsystem prefix (one-matcher dashboards, like
-    the scheduler and queue), and the engine's advertised pair exists."""
-    chaos_metrics = [
-        (file, line, kind, name)
-        for file, line, kind, name in _registered_metric_names()
-        if str(file).replace("\\", "/").startswith("mpi_operator_tpu/chaos/")
-    ]
-    assert chaos_metrics, "chaos metric registrations went missing"
-    bad = [
-        f"{file}:{line} {kind}({name!r}): missing tpu_operator_chaos_ prefix"
-        for file, line, kind, name in chaos_metrics
-        if not name.startswith("tpu_operator_chaos_")
-    ]
-    assert not bad, "\n".join(bad)
-    names = {name for _, _, _, name in chaos_metrics}
-    assert {
-        "tpu_operator_chaos_faults_injected_total",
-        "tpu_operator_chaos_pod_kills_total",
-    } <= names
