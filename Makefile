@@ -9,7 +9,7 @@ PYTHON ?= python3
 
 .PHONY: all native manifests verify-manifests lint analyze image \
         test-kernel test-kernel-smoke test-kernel-deep test-operator \
-        test test-unit test-integration test-e2e ci clean
+        test test-unit test-integration test-e2e bench-goodput ci clean
 
 all: native manifests
 
@@ -32,7 +32,7 @@ verify-manifests:
 # sandbox has neither and zero egress — docs/round4-notes.md logs the
 # attempt); the homegrown tier is the floor everywhere.
 lint: verify-manifests
-	$(PYTHON) -W error::SyntaxWarning -m compileall -q -f mpi_operator_tpu sdk hack tests bench.py __graft_entry__.py
+	$(PYTHON) -W error::SyntaxWarning -m compileall -q -f mpi_operator_tpu sdk hack tests bench.py bench_controlplane.py bench_goodput.py __graft_entry__.py
 	$(PYTHON) hack/lint.py
 	@if $(PYTHON) -c 'import ruff' 2>/dev/null; then \
 	    $(PYTHON) -m ruff check mpi_operator_tpu sdk hack tests; \
@@ -107,7 +107,13 @@ test-operator:
 test:
 	$(PYTHON) -m pytest tests -q $(XDIST)
 
-ci: lint analyze native test
+# Seeded goodput-under-preemption smoke (bench_goodput.py): 100 jobs at
+# kill rates 0/0.1/0.3 on the simulated clock, schema-checked artifact,
+# non-zero exit on non-convergence or a non-monotone goodput curve.
+bench-goodput:
+	$(PYTHON) bench_goodput.py --jobs 100 --seed 42 --out BENCH_GOODPUT.json
+
+ci: lint analyze native test bench-goodput
 
 clean:
 	$(MAKE) -C native clean

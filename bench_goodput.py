@@ -1,0 +1,589 @@
+#!/usr/bin/env python3
+"""Goodput-under-preemption benchmark: the goodput-vs-kill-rate curve.
+
+``bench_controlplane.py`` measures how fast the operator reconciles;
+this harness measures what the *jobs* get out of it — the fraction of
+each TPUJob's wall clock that was productive gang-running time, and
+where the rest went (queue wait, scheduling, pod startup, rendezvous,
+restart downtime), as attributed by the goodput ledger
+(utils/goodput.py) from flight-recorder timelines.
+
+It drives N queue-admitted, gang-scheduled TPUJobs to terminal state on
+a simulated clock at several chaos kill rates r (the PR-5 ``PodKiller``
+with the TPU preemption signature: SIGKILL 137 and node loss), with an
+``Ignore`` podFailurePolicy so preemptions never charge backoffLimit.
+Per rate it reports fleet goodput, per-phase wall seconds/shares, and
+the per-job per-phase *loss* versus the r=0 baseline — the curve the
+preemption papers (arxiv 1909.09756) draw from real fleets.
+
+Determinism: control logic runs on the simulated clock and every random
+choice comes from one ``random.Random(seed)`` (chaos draws from the
+seeded ChaosEngine), and every reported number derives from the sim
+clock — not wall time — so the same seed reproduces the artifact
+bit-for-bit.
+
+Run:  python bench_goodput.py --jobs 100 --seed 42
+      python bench_goodput.py --jobs 200 --rates 0,0.1,0.3
+Emits BENCH_GOODPUT.json (schema-checked; see docs/observability.md)
+and prints one JSON summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+
+from mpi_operator_tpu import chaos
+from mpi_operator_tpu.api.v2beta1 import (
+    REPLICA_TYPE_WORKER,
+    ReplicaSpec,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+)
+from mpi_operator_tpu.api.v2beta1 import constants
+from mpi_operator_tpu.api.v2beta1.types import (
+    PodFailurePolicy,
+    PodFailurePolicyOnExitCodes,
+    PodFailurePolicyOnPodCondition,
+    PodFailurePolicyRule,
+    SchedulingPolicy,
+)
+from mpi_operator_tpu.controller.tpu_job_controller import TPUJobController
+from mpi_operator_tpu.queue import QueueManager, bootstrap_queues
+from mpi_operator_tpu.runtime import retry
+from mpi_operator_tpu.runtime.apiserver import ApiError, InMemoryAPIServer
+from mpi_operator_tpu.scheduler import (
+    DEFAULT_SCHEDULER_NAME,
+    GangScheduler,
+    register_nodes,
+)
+from mpi_operator_tpu.utils import flightrecorder, goodput, metrics, statemetrics
+from mpi_operator_tpu.utils import logging as logutil
+
+TEMPLATE = {"spec": {"containers": [{"name": "main", "image": "tpu-image"}]}}
+NOW = 1000.0
+BENCH_QUEUE = "goodput-q"
+# v5e-16 = 4x4 chips = 4 hosts = a 4-worker gang per job.
+WORKERS_PER_JOB = 4
+CHIPS_PER_JOB = 16
+# The acceptance curve: baseline, moderate, heavy preemption pressure.
+KILL_RATES = (0.0, 0.1, 0.3)
+
+SCHEMA_VERSION = 1
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+class GoodputRunner:
+    """bench_controlplane.BenchRunner plus the two things this bench
+    needs: every phase flip lands on the owning job's flight-recorder
+    timeline (the ledger's raw input — in production the LocalPodRunner
+    does this), and the ``kill_pod``/``fail_node`` surface the PR-5
+    ``PodKiller`` drives.  A bound pod stays Pending for one tick before
+    Running, so pod startup occupies real (simulated) time."""
+
+    RUN_TICKS = 3
+
+    def __init__(
+        self,
+        api: InMemoryAPIServer,
+        recorder: flightrecorder.FlightRecorder,
+    ):
+        self.api = api
+        self.recorder = recorder
+        self._gang_age: dict[str, int] = {}
+        self._bound_seen: set[tuple[str, str]] = set()
+
+    def _flip(self, pod: dict, phase: str, reason: str = "",
+              message: str = "", exit_code=None) -> None:
+        meta = pod.get("metadata") or {}
+        status = dict(pod.get("status") or {})
+        status["phase"] = phase
+        if reason:
+            status["reason"] = reason
+        if message:
+            status["message"] = message
+        if exit_code is not None:
+            status["containerStatuses"] = [{
+                "name": "main",
+                "state": {"terminated": {"exitCode": exit_code}},
+            }]
+        pod["status"] = status
+        self.api.update_status("pods", pod)
+        job_name = (meta.get("labels") or {}).get(constants.JOB_NAME_LABEL)
+        if job_name:
+            attrs = {} if exit_code is None else {"exit_code": exit_code}
+            self.recorder.record(
+                meta.get("namespace", ""), job_name, flightrecorder.POD,
+                reason=reason or phase, message=message,
+                pod=meta.get("name", ""), phase=phase, **attrs,
+            )
+
+    def tick(self) -> None:
+        for pod in self.api.list("pods"):
+            meta = pod.get("metadata") or {}
+            key = (meta.get("namespace", ""), meta.get("name", ""))
+            status = pod.get("status") or {}
+            phase = status.get("phase") or "Pending"
+            if phase == "Pending" and (pod.get("spec") or {}).get("nodeName"):
+                # First sight of the binding: stage one tick of pod
+                # startup; second sight: the container comes up.
+                if key in self._bound_seen:
+                    self._bound_seen.discard(key)
+                    self._flip(pod, "Running")
+                else:
+                    self._bound_seen.add(key)
+            elif phase != "Pending":
+                self._bound_seen.discard(key)
+        gangs: dict[str, list[dict]] = {}
+        for pod in self.api.list("pods"):
+            name = ((pod.get("metadata") or {}).get("labels") or {}).get(
+                constants.JOB_NAME_LABEL
+            )
+            if name:
+                gangs.setdefault(name, []).append(pod)
+        for name in sorted(gangs):
+            members = gangs[name]
+            world = 0
+            for pod in members:
+                stamp = (
+                    (pod.get("metadata") or {}).get("annotations") or {}
+                ).get(constants.WORLD_SIZE_ANNOTATION)
+                if stamp:
+                    world = int(stamp)
+                    break
+            phases = [(p.get("status") or {}).get("phase") for p in members]
+            if world and len(members) == world and all(
+                ph == "Running" for ph in phases
+            ):
+                age = self._gang_age.get(name, 0) + 1
+                self._gang_age[name] = age
+                if age >= self.RUN_TICKS:
+                    for pod in members:
+                        self._flip(pod, "Succeeded", exit_code=0)
+            elif not all(ph == "Succeeded" for ph in phases):
+                self._gang_age[name] = 0
+
+    # -- PodKiller surface ----------------------------------------------
+
+    def kill_pod(self, namespace: str, name: str) -> bool:
+        """SIGKILL: the TPU preemption signature (exit code 137)."""
+        try:
+            pod = self.api.get("pods", namespace, name)
+        except ApiError:
+            return False
+        if (pod.get("status") or {}).get("phase") != "Running":
+            return False
+        self._flip(pod, "Failed", reason="Killed",
+                   message="chaos: SIGKILL", exit_code=137)
+        return True
+
+    def fail_node(self, namespace: str, name: str) -> bool:
+        """Node death: Failed with reason=NodeLost, no exit code."""
+        try:
+            pod = self.api.get("pods", namespace, name)
+        except ApiError:
+            return False
+        if (pod.get("status") or {}).get("phase") != "Running":
+            return False
+        self._flip(pod, "Failed", reason="NodeLost",
+                   message="chaos: node died")
+        return True
+
+
+def ignore_preemption_rules() -> PodFailurePolicy:
+    """Preemptions are not the job's fault: Ignore 137 and node loss so
+    chaos kills replace pods without charging backoffLimit."""
+    return PodFailurePolicy(rules=[
+        PodFailurePolicyRule(
+            action="Ignore",
+            on_exit_codes=PodFailurePolicyOnExitCodes(
+                operator="In", values=[137]
+            ),
+        ),
+        PodFailurePolicyRule(
+            action="Ignore",
+            on_pod_conditions=[
+                PodFailurePolicyOnPodCondition(reason="NodeLost")
+            ],
+        ),
+    ])
+
+
+def goodput_job(name: str) -> TPUJob:
+    job = TPUJob()
+    job.metadata.name = name
+    job.metadata.namespace = "default"
+    job.spec = TPUJobSpec(
+        tpu=TPUSpec(accelerator_type="v5e-16"),
+        replica_specs={
+            REPLICA_TYPE_WORKER: ReplicaSpec(
+                replicas=WORKERS_PER_JOB, template=dict(TEMPLATE)
+            )
+        },
+    )
+    # Keep terminal pods (clean_pod_policy=None): post-mortem timelines
+    # are the measurement here, and terminal pods hold no capacity.
+    job.spec.run_policy.clean_pod_policy = "None"
+    job.spec.run_policy.backoff_limit = 3
+    job.spec.run_policy.pod_failure_policy = ignore_preemption_rules()
+    job.spec.run_policy.scheduling_policy = SchedulingPolicy(queue=BENCH_QUEUE)
+    return job
+
+
+def run_rate(
+    kill_rate: float, jobs: int, seed: int, max_rounds: int = 0
+) -> dict:
+    """Drive ``jobs`` TPUJobs to terminal state at one chaos kill rate;
+    return the per-rate result block of BENCH_GOODPUT.json.  Every
+    reported number derives from the simulated clock, so same seed =>
+    bit-identical block."""
+    concurrency = min(64, max(8, jobs // 16))
+    rng = random.Random(seed)
+
+    time_ = [NOW]
+    clock = lambda: time_[0]  # noqa: E731
+    raw = InMemoryAPIServer(clock=clock)
+    registry = metrics.Registry()
+    recorder = flightrecorder.FlightRecorder(
+        capacity_per_job=1024, max_jobs=jobs + 8, clock=clock
+    )
+    ledger = goodput.GoodputLedger(recorder, registry=registry, clock=clock)
+
+    register_nodes(raw, f"v5e-16:{concurrency}")
+    bootstrap_queues(
+        raw, [f"{BENCH_QUEUE}:v5e={CHIPS_PER_JOB * concurrency}"],
+        namespace="default",
+    )
+
+    controller = TPUJobController(
+        raw, gang_scheduler_name=DEFAULT_SCHEDULER_NAME,
+        registry=registry, clock=clock, flight_recorder=recorder,
+    )
+    manager = QueueManager(
+        raw, registry=registry, clock=clock, flight_recorder=recorder
+    )
+    scheduler = GangScheduler(
+        raw, registry=registry, clock=clock, gang_wait_timeout=1e9,
+        flight_recorder=recorder,
+    )
+    runner = GoodputRunner(raw, recorder)
+
+    killer = None
+    engine = None
+    kills_budget = 0
+    if kill_rate > 0:
+        # 90/10 SIGKILL/node-death mix, budgeted so the fleet converges
+        # once the chaos quota is spent.
+        kills_budget = max(1, int(jobs * kill_rate * 2))
+        engine = chaos.ChaosEngine(chaos.ChaosPolicy(
+            seed=seed,
+            pods=(chaos.PodChaos(
+                kill_rate=kill_rate * 0.9,
+                node_death_rate=kill_rate * 0.1,
+                roles=(constants.ROLE_WORKER,),
+                namespace="default",
+                max_kills=kills_budget,
+            ),),
+        ))
+        killer = chaos.PodKiller(engine, raw, runner)
+
+    # Simulated clocks everywhere control logic reads time (the chaos
+    # soak idiom), so the drive loop is seed-deterministic.
+    for factory in (controller.factory, manager.factory):
+        factory.set_resync_interval(4.0)
+        for informer in factory._informers.values():
+            informer._clock = clock
+    controller.queue._clock = clock
+    manager.queue._clock = clock
+    controller.start()
+    manager.start()
+
+    names = [f"goodput-{i:05d}" for i in range(jobs)]
+    rng.shuffle(names)
+    log(f"creating {jobs} TPUJobs at kill rate {kill_rate} "
+        f"({WORKERS_PER_JOB}-worker gangs, concurrency {concurrency})...")
+    wall0 = time.perf_counter()
+    for name in names:
+        raw.create("tpujobs", goodput_job(name).to_dict())
+
+    def pump():
+        for _ in range(10):
+            if controller.factory.pump_all() + manager.factory.pump_all() == 0:
+                return
+
+    def drain_controller_queue():
+        for _ in range(jobs * 4 + 100):
+            key, _ = controller.queue.get(timeout=0)
+            if key is None:
+                return
+            try:
+                controller.sync_handler(key)
+            except ApiError:
+                controller.queue.add_rate_limited(key)
+            else:
+                controller.queue.forget(key)
+            finally:
+                controller.queue.done(key)
+
+    real_sleep = retry.sleep
+    retry.sleep = lambda s: None
+
+    if max_rounds <= 0:
+        # Baseline waves plus a recovery allowance per budgeted kill
+        # (reschedule + startup + RUN_TICKS, padded).
+        waves = (jobs + concurrency - 1) // concurrency
+        max_rounds = 40 + 16 * waves + 12 * kills_budget
+
+    rounds_used = None
+    try:
+        for rnd in range(max_rounds):
+            time_[0] += 1.0
+            pump()
+            try:
+                manager.sync_handler("bench-tick")
+            except ApiError:
+                pass
+            pump()
+            drain_controller_queue()
+            pump()
+            try:
+                scheduler.schedule_once()
+            except ApiError:
+                pass
+            if killer is not None:
+                killer.tick()
+            runner.tick()
+            done = (controller.jobs_successful.value()
+                    + controller.jobs_failed.value())
+            if done >= jobs:
+                rounds_used = rnd + 1
+                break
+    finally:
+        retry.sleep = real_sleep
+        scheduler.stop()
+
+    # Settling sweep: the manager observes the last finishes and
+    # releases their quota charges.
+    pump()
+    try:
+        manager.sync_handler("bench-final")
+    except ApiError:
+        manager.sync_handler("bench-final-retry")
+    log(f"rate {kill_rate}: drove to round {rounds_used} in "
+        f"{time.perf_counter() - wall0:.2f}s wall")
+
+    # Ground-truth outcomes from the apiserver, not the counters.
+    outcomes: dict[str, int] = {}
+    for job in raw.list("tpujobs", "default"):
+        phase = statemetrics.job_phase(job)
+        outcomes[phase] = outcomes.get(phase, 0) + 1
+    converged = (
+        rounds_used is not None
+        and sum(outcomes.get(p, 0) for p in ("Succeeded", "Failed")) == jobs
+    )
+
+    fleet = ledger.fleet_snapshot(now=time_[0])
+    kills = 0
+    if engine is not None:
+        kills = sum(
+            1 for kind, _, _ in engine.timeline()
+            if kind in (chaos.POD_KILL, chaos.NODE_DEATH)
+        )
+    attributed = sum(fleet["phase_seconds"].values())
+    wall_total = fleet["wall_seconds"]
+    residual = (
+        abs(attributed - wall_total) / wall_total if wall_total > 0 else 0.0
+    )
+    return {
+        "kill_rate": kill_rate,
+        "jobs": jobs,
+        "seed": seed,
+        "concurrency": concurrency,
+        "converged": converged,
+        "rounds": rounds_used,
+        "sim_seconds": round(time_[0] - NOW, 6),
+        "outcomes": outcomes,
+        "kills": kills,
+        "restarts_total": fleet["restarts"],
+        "goodput_ratio": fleet["goodput_ratio"],
+        "wall_seconds_total": wall_total,
+        "phase_seconds": fleet["phase_seconds"],
+        "phase_shares": fleet["phase_shares"],
+        "attribution_residual_ratio": round(residual, 6),
+    }
+
+
+# ----------------------------------------------------------------------
+# Artifact schema
+# ----------------------------------------------------------------------
+
+_RESULT_KEYS = {
+    "kill_rate": float,
+    "jobs": int,
+    "seed": int,
+    "converged": bool,
+    "sim_seconds": float,
+    "outcomes": dict,
+    "kills": int,
+    "restarts_total": int,
+    "goodput_ratio": float,
+    "wall_seconds_total": float,
+    "phase_seconds": dict,
+    "phase_shares": dict,
+    "attribution_residual_ratio": float,
+    "loss_attribution_vs_baseline": dict,
+}
+
+
+def check_schema(doc: dict) -> None:
+    """Schema gate for BENCH_GOODPUT.json; raises ValueError with a
+    path-qualified message on the first violation.  Beyond shape, it
+    enforces the ledger's core invariants: the phase vocabulary is
+    closed, and per-phase seconds sum to the fleet wall time within 1%."""
+    if doc.get("schema_version") != SCHEMA_VERSION:
+        raise ValueError(
+            f"schema_version: expected {SCHEMA_VERSION}, "
+            f"got {doc.get('schema_version')!r}"
+        )
+    if doc.get("benchmark") != "goodput":
+        raise ValueError(f"benchmark: got {doc.get('benchmark')!r}")
+    curve = doc.get("curve")
+    if not isinstance(curve, list) or not curve:
+        raise ValueError("curve: expected a non-empty list")
+    for i, point in enumerate(curve):
+        for key in ("kill_rate", "goodput_ratio"):
+            if not isinstance(point.get(key), (int, float)):
+                raise ValueError(f"curve[{i}].{key}: missing or non-numeric")
+    results = doc.get("results")
+    if not isinstance(results, list) or not results:
+        raise ValueError("results: expected a non-empty list")
+    if len(curve) != len(results):
+        raise ValueError(
+            f"curve: {len(curve)} points for {len(results)} results"
+        )
+    vocabulary = set(goodput.GOODPUT_PHASES)
+    for i, res in enumerate(results):
+        where = f"results[{i}]"
+        for key, type_ in _RESULT_KEYS.items():
+            if key not in res:
+                raise ValueError(f"{where}.{key}: missing")
+            value = res[key]
+            if type_ is float and isinstance(value, int):
+                value = float(value)
+            if not isinstance(value, type_):
+                raise ValueError(
+                    f"{where}.{key}: expected {type_.__name__}, "
+                    f"got {type(res[key]).__name__}"
+                )
+        for field in ("phase_seconds", "phase_shares",
+                      "loss_attribution_vs_baseline"):
+            if set(res[field]) != vocabulary:
+                raise ValueError(
+                    f"{where}.{field}: phase keys {sorted(res[field])} != "
+                    f"goodput vocabulary {sorted(vocabulary)}"
+                )
+        wall = res["wall_seconds_total"]
+        attributed = sum(res["phase_seconds"].values())
+        if wall > 0 and abs(attributed - wall) > 0.01 * wall:
+            raise ValueError(
+                f"{where}.phase_seconds: sum {attributed:.6f} deviates "
+                f">1% from wall_seconds_total {wall:.6f}"
+            )
+        if not 0.0 <= res["goodput_ratio"] <= 1.0:
+            raise ValueError(
+                f"{where}.goodput_ratio: {res['goodput_ratio']} not in [0,1]"
+            )
+
+
+def build_doc(
+    rates: list[float], jobs: int, seed: int, max_rounds: int = 0
+) -> dict:
+    results = []
+    for rate in rates:
+        result = run_rate(rate, jobs, seed, max_rounds=max_rounds)
+        log(
+            f"rate {rate}: converged={result['converged']} in "
+            f"{result['rounds']} rounds, goodput "
+            f"{result['goodput_ratio']:.4f}, {result['kills']} kills, "
+            f"{result['restarts_total']} restarts"
+        )
+        results.append(result)
+    # Per-job average per-phase seconds lost versus the first rate (the
+    # baseline): where does preemption pressure put the time?
+    base = results[0]
+    for res in results:
+        res["loss_attribution_vs_baseline"] = {
+            p: round(
+                res["phase_seconds"][p] / res["jobs"]
+                - base["phase_seconds"][p] / base["jobs"], 6,
+            )
+            for p in goodput.GOODPUT_PHASES
+        }
+    return {
+        "benchmark": "goodput",
+        "schema_version": SCHEMA_VERSION,
+        "jobs": jobs,
+        "seed": seed,
+        "kill_rates": list(rates),
+        "curve": [
+            {"kill_rate": r["kill_rate"], "goodput_ratio": r["goodput_ratio"]}
+            for r in results
+        ],
+        "results": results,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench-goodput",
+        description="goodput-under-preemption benchmark (memory backend)",
+    )
+    p.add_argument("--jobs", type=int, default=100)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--rates", default=",".join(str(r) for r in KILL_RATES),
+                   help="comma-separated chaos kill rates (e.g. 0,0.1,0.3)")
+    p.add_argument("--max-rounds", type=int, default=0,
+                   help="round budget per rate (0 = auto from fleet size)")
+    p.add_argument("--out", default="BENCH_GOODPUT.json")
+    args = p.parse_args(argv)
+
+    logutil.configure(level=logutil.parse_level("warning"))
+    rates = [float(r) for r in args.rates.split(",") if r.strip()]
+    doc = build_doc(rates, args.jobs, args.seed, args.max_rounds)
+    check_schema(doc)
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    log(f"wrote {args.out}")
+
+    curve = doc["curve"]
+    print(json.dumps({
+        "metric": "goodput_vs_kill_rate",
+        "value": curve[-1]["goodput_ratio"],
+        "unit": (
+            f"fleet goodput at kill rate {curve[-1]['kill_rate']} "
+            f"({doc['jobs']} jobs, seed {doc['seed']})"
+        ),
+        "curve": curve,
+        "restart_downtime_share": doc["results"][-1]["phase_shares"][
+            goodput.PHASE_RESTART_DOWNTIME
+        ],
+    }))
+    ok = all(r["converged"] for r in doc["results"])
+    # Preemption must not *improve* goodput: the curve is monotone
+    # (within float dust) from the r=0 baseline down.
+    if curve[0]["goodput_ratio"] + 1e-9 < curve[-1]["goodput_ratio"]:
+        log("FAIL: goodput at baseline below goodput at max kill rate")
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -388,6 +388,12 @@ _REQUIRED_FAMILIES = [
         "tpu_operator_pods_by_phase",
         "tpu_operator_job_condition",
     }),
+    ("mpi_operator_tpu/utils/goodput.py", {
+        "tpu_operator_job_goodput_ratio",
+        "tpu_operator_job_phase_seconds",
+        "tpu_operator_job_goodput_fleet_ratio",
+        "tpu_operator_job_phase_fleet_seconds",
+    }),
 ]
 
 
@@ -406,6 +412,28 @@ def check_required_metric_families(repo: RepoView) -> Iterable[Finding]:
         for name in sorted(required - registered):
             yield Finding(anchor, 1, "TPU110",
                           f"required metric {name!r} is not registered")
+
+
+# The goodput ledger's families are an *attribution*: a second writer
+# under these prefixes would double-count phases or split the series
+# across owners, and dashboards keyed on the prefix could not tell.
+_GOODPUT_PREFIXES = ("tpu_operator_job_goodput", "tpu_operator_job_phase")
+_GOODPUT_OWNER = "mpi_operator_tpu/utils/goodput.py"
+
+
+@rule("TPU111", "goodput-metric-sole-writer",
+      "The tpu_operator_job_goodput*/tpu_operator_job_phase* metric "
+      "prefixes are reserved for utils/goodput.py, the goodput ledger.")
+def check_goodput_sole_writer(repo: RepoView) -> Iterable[Finding]:
+    for sf, line, kind, name, _ in _metric_registrations(repo):
+        if not name.startswith(_GOODPUT_PREFIXES):
+            continue
+        if sf.rel != _GOODPUT_OWNER:
+            yield Finding(
+                sf.rel, line, "TPU111",
+                f"{kind}({name!r}): goodput/phase metric prefixes are "
+                f"reserved for {_GOODPUT_OWNER}",
+            )
 
 
 # ----------------------------------------------------------------------

@@ -38,7 +38,7 @@ from ..runtime import locktrace
 from ..runtime.apiserver import InMemoryAPIServer, NotFoundError
 from ..runtime.leaderelection import LeaderElectionConfig, LeaderElector
 from ..runtime.podrunner import LocalPodRunner
-from ..utils import flightrecorder, metrics, profiling, trace
+from ..utils import flightrecorder, goodput, metrics, profiling, trace
 from ..utils import logging as logutil
 from ..version import version_string
 
@@ -123,25 +123,79 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _parse_timeline_query(query: str) -> tuple[Optional[str], Optional[int]]:
+    """``?limit=N&kind=K`` for the timeline endpoint; raises ValueError
+    (the endpoint's 400) on malformed values so large timelines stay
+    bounded over HTTP without silently serving the wrong slice."""
+    from urllib.parse import parse_qs
+
+    params = parse_qs(query, keep_blank_values=True)
+    kind: Optional[str] = None
+    limit: Optional[int] = None
+    if "kind" in params:
+        kind = params["kind"][-1]
+        if kind not in flightrecorder.KINDS:
+            raise ValueError(
+                f"kind must be one of {', '.join(flightrecorder.KINDS)}; "
+                f"got {kind!r}"
+            )
+    if "limit" in params:
+        raw = params["limit"][-1]
+        try:
+            limit = int(raw)
+        except ValueError:
+            raise ValueError(f"limit must be an integer; got {raw!r}")
+        if limit < 1:
+            raise ValueError(f"limit must be >= 1; got {limit}")
+    return kind, limit
+
+
 class _MonitoringHandler(BaseHTTPRequestHandler):
     registry: metrics.Registry = None
     tracer: trace.Tracer = None
     flight_recorder: Optional[flightrecorder.FlightRecorder] = None
+    goodput_ledger: Optional[goodput.GoodputLedger] = None
     profiler: Optional[profiling.PhaseProfiler] = None
     workqueues: tuple = ()
     health_fn = staticmethod(lambda: True)
 
-    def _timeline_body(self) -> Optional[bytes]:
-        """Body for /debug/jobs/<ns>/<name>/timeline, or None for 404
-        (no recorder wired, or a job the recorder has never seen)."""
-        parts = self.path.split("/")
-        # ['', 'debug', 'jobs', ns, name, 'timeline']
-        if len(parts) != 6 or parts[5] != "timeline":
-            return None
-        if self.flight_recorder is None:
-            return None
-        timeline = self.flight_recorder.to_json(parts[3], parts[4])
-        return None if timeline is None else timeline.encode()
+    def _debug_jobs_response(self) -> tuple[int, str, bytes]:
+        """(status, content-type, body) for the per-job debug pages:
+        /debug/jobs/<ns>/<name>/timeline (with ?limit=N / ?kind=K
+        filters; 400 on malformed values) and
+        /debug/jobs/<ns>/<name>/goodput (the ledger's phase
+        decomposition).  404 when the page, the backing component, or
+        the job itself is unknown."""
+        import json
+        from urllib.parse import urlsplit
+
+        split = urlsplit(self.path)
+        parts = split.path.split("/")
+        # ['', 'debug', 'jobs', ns, name, leaf]
+        if len(parts) != 6 or parts[5] not in ("timeline", "goodput"):
+            return 404, "text/plain", b"not found"
+        namespace, name, leaf = parts[3], parts[4], parts[5]
+        if leaf == "timeline":
+            if self.flight_recorder is None:
+                return 404, "text/plain", b"not found"
+            try:
+                kind, limit = _parse_timeline_query(split.query)
+            except ValueError as exc:
+                return 400, "text/plain", f"bad request: {exc}".encode()
+            timeline = self.flight_recorder.to_json(
+                namespace, name, kind=kind, limit=limit
+            )
+            if timeline is None:
+                return 404, "text/plain", b"not found"
+            return 200, "application/json", timeline.encode()
+        if self.goodput_ledger is None:
+            return 404, "text/plain", b"not found"
+        snap = self.goodput_ledger.job_snapshot(namespace, name)
+        if snap is None:
+            return 404, "text/plain", b"not found"
+        return 200, "application/json", (
+            json.dumps(snap, indent=2, sort_keys=True) + "\n"
+        ).encode()
 
     def do_GET(self):  # noqa: N802
         if self.path == "/metrics":
@@ -149,12 +203,23 @@ class _MonitoringHandler(BaseHTTPRequestHandler):
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
         elif self.path.startswith("/debug/jobs/"):
-            body = self._timeline_body()
-            if body is None:
+            status, content_type, body = self._debug_jobs_response()
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+        elif self.path == "/debug/goodput":
+            # Fleet goodput rollup: aggregate ratio, per-phase totals,
+            # and the per-job table (see docs/observability.md).
+            import json
+
+            if self.goodput_ledger is None:
                 body = b"not found"
                 self.send_response(404)
                 self.send_header("Content-Type", "text/plain")
             else:
+                doc = self.goodput_ledger.fleet_snapshot()
+                body = (
+                    json.dumps(doc, indent=2, sort_keys=True) + "\n"
+                ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
         elif self.path == "/healthz":
@@ -202,13 +267,16 @@ def start_monitoring(port: int, registry: metrics.Registry, health_fn,
                      tracer: Optional[trace.Tracer] = None,
                      flight_recorder: Optional[
                          flightrecorder.FlightRecorder] = None,
+                     goodput_ledger: Optional[goodput.GoodputLedger] = None,
                      profiler: Optional[profiling.PhaseProfiler] = None,
                      workqueues=()):
     """startMonitoring (main.go:29-40) + healthz server (:192-208) analog,
     plus the ``/debug/trace`` span dump, per-job
-    ``/debug/jobs/<ns>/<name>/timeline`` flight-recorder endpoint, and the
-    ``/debug/profile`` phase-profile snapshot (``profiler`` plus the
-    ``workqueues`` whose health it reports)."""
+    ``/debug/jobs/<ns>/<name>/timeline`` flight-recorder endpoint (with
+    ``?limit=``/``?kind=`` filters), the goodput pages
+    (``/debug/jobs/<ns>/<name>/goodput`` + fleet ``/debug/goodput``),
+    and the ``/debug/profile`` phase-profile snapshot (``profiler`` plus
+    the ``workqueues`` whose health it reports)."""
     handler = type(
         "Handler",
         (_MonitoringHandler,),
@@ -217,6 +285,7 @@ def start_monitoring(port: int, registry: metrics.Registry, health_fn,
             # "is None", not "or": an empty Tracer is falsy (__len__).
             "tracer": trace.DEFAULT_TRACER if tracer is None else tracer,
             "flight_recorder": flight_recorder,
+            "goodput_ledger": goodput_ledger,
             "profiler": profiler,
             "workqueues": tuple(workqueues),
             "health_fn": staticmethod(health_fn),
@@ -331,6 +400,9 @@ def run(argv=None) -> int:
     recorder = flightrecorder.FlightRecorder()
     if runner is not None:
         runner.flight_recorder = recorder
+    # The goodput ledger rides the recorder: per-job phase attribution,
+    # scrape-time goodput metrics, and the /debug/goodput rollup.
+    ledger = goodput.GoodputLedger(recorder, registry=registry)
     is_leader = metrics.new_gauge(
         "tpu_operator_is_leader", "1 if this replica is the leader", (), registry
     )
@@ -483,6 +555,7 @@ def run(argv=None) -> int:
         start_monitoring(
             args.monitoring_port, registry, health,
             address=args.monitoring_address, flight_recorder=recorder,
+            goodput_ledger=ledger,
             profiler=profiling.profiler_for(registry), workqueues=queues,
         )
         print(

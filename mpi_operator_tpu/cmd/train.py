@@ -963,7 +963,9 @@ def main(argv=None) -> int:
                 else:  # still inside warmup: loss only, no bogus timing
                     log.info("step %d: loss=%.4f (warmup)", step, float(loss))
             if ckpt is not None:
+                t_ckpt = time.perf_counter()
                 ckpt.save(step, work.state)
+                telem.record_checkpoint(time.perf_counter() - t_ckpt)
             stop_now = preempted.is_set()
             if sync_preempt is not None:
                 stop_now = sync_preempt(stop_now)
@@ -983,16 +985,20 @@ def main(argv=None) -> int:
         final_loss = float(loss)
 
     if ckpt is not None:
+        t_ckpt = time.perf_counter()
         ckpt.save(step, work.state, force=True)
         ckpt.wait_until_finished()
         ckpt.close()
+        telem.record_checkpoint(time.perf_counter() - t_ckpt)
     # Only after the checkpoint is durable: a second SIGTERM during the
     # commit must not kill the process mid-write.
     signal.signal(signal.SIGTERM, prev_handler)
 
     # Goodput AFTER the final checkpoint commit: durable-save time is
     # exactly the kind of non-productive wall time it should expose.
-    telem.close(step)
+    # On the preemption path the record is forced: the partial step
+    # count and goodput must land in the JSONL before the pod dies.
+    telem.close(step, final=preempted.is_set())
     examples_per_sec = (
         work.examples_per_step * timed_steps / elapsed if elapsed > 0 else 0.0
     )

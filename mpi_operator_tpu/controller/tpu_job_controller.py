@@ -379,10 +379,13 @@ class TPUJobController:
         *,
         status: str = st.CONDITION_TRUE,
         now: float,
+        **attrs,
     ) -> None:
         """update_job_conditions + the condition-transition timestamp
         metric: the gauge only moves when the stored conditions actually
-        changed, so re-syncs never smear transition times."""
+        changed, so re-syncs never smear transition times.  Extra
+        ``attrs`` ride the flight-recorder entry (goodput attribution
+        context, e.g. how many workers a restart replaced)."""
         if st.update_job_conditions(
             job, type_, reason, message, status=status, now=now
         ):
@@ -401,6 +404,7 @@ class TPUJobController:
                 message=message,
                 type=type_,
                 status=status,
+                **attrs,
             )
             self.log.info(
                 "condition %s=%s (%s)", type_, status, reason,
@@ -914,6 +918,7 @@ class TPUJobController:
                 st.TPUJOB_RESTARTING_REASON,
                 msg,
                 now=self.clock(),
+                restarted_workers=len(restarted),
             )
             self.recorder.event(
                 job, EVENT_TYPE_NORMAL, st.TPUJOB_RESTARTING_REASON, msg

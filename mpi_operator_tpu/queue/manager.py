@@ -498,6 +498,7 @@ class QueueManager:
         self._set_job_condition(
             live, JOB_QUOTA_RESERVED, ADMITTED_REASON, msg,
             status=st.CONDITION_TRUE, now=now, write=True,
+            queue=cq_name, chips=chips,
         )
         self.recorder.event(live, EVENT_TYPE_NORMAL, ADMITTED_REASON, msg)
         self._last_failure_msg.pop(f"{job.namespace}/{job.name}", None)
@@ -533,6 +534,7 @@ class QueueManager:
         self._set_job_condition(
             victim, JOB_QUOTA_RESERVED, EVICTED_REASON, msg,
             status=st.CONDITION_FALSE, now=now, write=True,
+            queue=charge.queue, chips=charge.chips,
         )
         self.recorder.event(victim, EVENT_TYPE_WARNING, EVICTED_REASON, msg)
         self.evictions.inc(1, charge.queue)
@@ -635,8 +637,11 @@ class QueueManager:
 
     def _set_job_condition(
         self, job: TPUJob, type_: str, reason: str, message: str, *,
-        status: str, now: float, write: bool,
+        status: str, now: float, write: bool, **attrs,
     ) -> bool:
+        """Extra ``attrs`` ride the flight-recorder entry so the goodput
+        ledger can attribute queue-wait time to a specific ClusterQueue
+        without parsing the human-readable message."""
         if not st.update_job_conditions(
             job, type_, reason, message, status=status, now=now
         ):
@@ -644,6 +649,7 @@ class QueueManager:
         self.flight_recorder.record(
             job.namespace, job.name, flightrecorder.CONDITION,
             reason=reason, message=message, type=type_, status=status,
+            **attrs,
         )
         if write:
             self._write_status(job)

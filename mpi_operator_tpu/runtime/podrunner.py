@@ -371,22 +371,29 @@ class LocalPodRunner:
                            reason=reason)
         else:
             self.log.debug("pod %s/%s -> %s", key[0], key[1], phase)
-        self._record_pod_flip(pod, phase, reason, message)
+        self._record_pod_flip(pod, phase, reason, message, exit_code)
         if phase == "Succeeded":
             self._mirror_job_success(pod)
 
     def _record_pod_flip(
-        self, pod: dict, phase: str, reason: str, message: str
+        self, pod: dict, phase: str, reason: str, message: str,
+        exit_code: Optional[int] = None,
     ) -> None:
         """Put the phase flip on the owning TPUJob's flight-recorder
         timeline.  Worker pods carry the job-name label directly; launcher
-        pods are owned by a batch Job whose template carries it too."""
+        pods are owned by a batch Job whose template carries it too.
+        The exit code rides along when the kubelet reported one, so the
+        goodput ledger can tell a preemption (137) from a crash without
+        re-reading the pod."""
         if self.flight_recorder is None:
             return
         labels = pod["metadata"].get("labels") or {}
         job_name = labels.get(constants.JOB_NAME_LABEL)
         if not job_name:
             return
+        attrs = {}
+        if exit_code is not None:
+            attrs["exit_code"] = exit_code
         self.flight_recorder.record(
             pod["metadata"].get("namespace", ""),
             job_name,
@@ -395,6 +402,7 @@ class LocalPodRunner:
             message=message[-256:] if message else "",
             pod=pod["metadata"]["name"],
             phase=phase,
+            **attrs,
         )
 
     def pod_log(self, namespace: str, name: str) -> str:

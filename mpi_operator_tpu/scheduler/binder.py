@@ -33,12 +33,20 @@ class BindError(RuntimeError):
     """A bind attempt failed; the caller must roll the gang back."""
 
 
-def scheduled_condition(status: str, reason: str = "", message: str = "") -> dict:
+def scheduled_condition(
+    status: str, reason: str = "", message: str = "",
+    now: Optional[float] = None,
+) -> dict:
     cond = {"type": "PodScheduled", "status": status}
     if reason:
         cond["reason"] = reason
     if message:
         cond["message"] = message
+    if now is not None:
+        # Real pod conditions carry lastTransitionTime; downstream
+        # consumers (goodput attribution, kubectl-style describes) read
+        # scheduling latency straight off the condition.
+        cond["lastTransitionTime"] = round(now, 6)
     return cond
 
 
@@ -80,7 +88,9 @@ class Binder:
                     f"pod {namespace}/{name} already bound to "
                     f"{pod['spec']['nodeName']!r}"
                 )
-            set_pod_condition(pod, scheduled_condition("True"))
+            set_pod_condition(
+                pod, scheduled_condition("True", now=self._clock())
+            )
             pod["status"].setdefault("phase", "Pending")
             pod = self._api.update_status("pods", pod)
             pod["spec"]["nodeName"] = node_name
@@ -115,7 +125,10 @@ class Binder:
         if ("PodScheduled", "False", message) in existing:
             return  # no-op write would still bump resourceVersion
         set_pod_condition(
-            pod, scheduled_condition("False", reason="Unschedulable", message=message)
+            pod, scheduled_condition(
+                "False", reason="Unschedulable", message=message,
+                now=self._clock(),
+            )
         )
         pod["status"].setdefault("phase", "Pending")
         try:

@@ -535,6 +535,7 @@ class GangScheduler:
             ev.SCHEDULED_REASON,
             f"gang {gang_key[1]} bound to {', '.join(nodes)}",
             pod_count=len(assignments),
+            wait_seconds=round(max(0.0, now - first_seen), 6),
         )
         return True
 

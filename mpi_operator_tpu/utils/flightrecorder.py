@@ -32,6 +32,7 @@ CONDITION = "condition"
 EVENT = "event"
 SCHEDULING = "scheduling"
 POD = "pod"
+KINDS = (CONDITION, EVENT, SCHEDULING, POD)
 
 
 class FlightRecorder:
@@ -101,21 +102,51 @@ class FlightRecorder:
             count=getattr(ev, "count", 1),
         )
 
-    def timeline(self, namespace: str, name: str) -> Optional[list]:
+    def timeline(
+        self,
+        namespace: str,
+        name: str,
+        *,
+        kind: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> Optional[list]:
         """Ordered entries for one job; None when the job was never seen
-        (distinguishes 404 from an empty-but-known timeline)."""
+        (distinguishes 404 from an empty-but-known timeline).  ``kind``
+        keeps only entries of that kind; ``limit`` keeps the *newest* N
+        after filtering (the tail is what post-mortems read first)."""
         with self._lock:
             timeline = self._jobs.get((namespace, name))
-            return None if timeline is None else list(timeline)
+            if timeline is None:
+                return None
+            entries = list(timeline)
+        if kind is not None:
+            entries = [e for e in entries if e.get("kind") == kind]
+        if limit is not None and limit >= 0:
+            entries = entries[-limit:] if limit > 0 else []
+        return entries
 
-    def timeline_object(self, namespace: str, name: str) -> Optional[dict]:
-        entries = self.timeline(namespace, name)
+    def timeline_object(
+        self,
+        namespace: str,
+        name: str,
+        *,
+        kind: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> Optional[dict]:
+        entries = self.timeline(namespace, name, kind=kind, limit=limit)
         if entries is None:
             return None
         return {"namespace": namespace, "name": name, "entries": entries}
 
-    def to_json(self, namespace: str, name: str) -> Optional[str]:
-        obj = self.timeline_object(namespace, name)
+    def to_json(
+        self,
+        namespace: str,
+        name: str,
+        *,
+        kind: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> Optional[str]:
+        obj = self.timeline_object(namespace, name, kind=kind, limit=limit)
         return None if obj is None else json.dumps(obj, sort_keys=True)
 
     def jobs(self) -> list:

@@ -104,6 +104,7 @@ class TrainingTelemetry:
 
         self._origin: Optional[float] = None
         self._productive_s = 0.0
+        self._checkpoint_s = 0.0
         self._last_emit_step = 0
         self._last_emit_time: Optional[float] = None
         self._last_emit_productive = 0.0
@@ -128,6 +129,13 @@ class TrainingTelemetry:
                 self.examples_total.inc(self.examples_per_step)
         if self.interval and step % self.interval == 0:
             self.emit(step)
+
+    def record_checkpoint(self, duration_s: float) -> None:
+        """Charge durable-save wall time.  Checkpoint seconds stay in the
+        goodput denominator (they are not productive step time) but are
+        reported separately so the operator-side goodput ledger can carve
+        them out of the job's productive phase."""
+        self._checkpoint_s += max(0.0, duration_s)
 
     # -- derived numbers -------------------------------------------------
 
@@ -167,6 +175,8 @@ class TrainingTelemetry:
             rec["tokens_per_sec"] = round(rate * self.tokens_per_step, 1)
         if self.examples_per_step:
             rec["examples_per_sec"] = round(rate * self.examples_per_step, 1)
+        if self._checkpoint_s > 0:
+            rec["checkpoint_s"] = round(self._checkpoint_s, 3)
         self.goodput.set(round(goodput, 6))
         self.throughput.set(
             round(rate * (self.tokens_per_step or self.examples_per_step), 3)
@@ -176,19 +186,24 @@ class TrainingTelemetry:
         self._last_emit_productive = self._productive_s
         return rec
 
-    def emit(self, step: int) -> dict:
+    def emit(self, step: int, *, final: bool = False) -> dict:
         rec = self.snapshot(step)
+        if final:
+            rec["final"] = True
         # Shared structured-log writer: same sorted-keys one-object-per-line
         # shape as before, with flush + write locking for free.
         emit_json(rec, stream=self._file if self._file is not None else self._stream)
         return rec
 
-    def close(self, step: int) -> Optional[dict]:
-        """Final emit (if enabled and a step landed since the last one),
-        then file close."""
+    def close(self, step: int, *, final: bool = False) -> Optional[dict]:
+        """Final emit, then file close.  Plain shutdown emits only when
+        periodic records are enabled and a step landed since the last
+        one; ``final=True`` (the preemption/SIGTERM path) always emits,
+        so a killed worker's partial goodput and step count are never
+        lost with the process."""
         rec = None
-        if self.interval and step > self._last_emit_step:
-            rec = self.emit(step)
+        if final or (self.interval and step > self._last_emit_step):
+            rec = self.emit(step, final=final)
         if self._file is not None:
             self._file.close()
             self._file = None

@@ -97,9 +97,32 @@ class TestFramework:
     def test_rule_registry_has_stable_ids(self):
         ids = [r.id for r in framework.all_rules()]
         assert ids == sorted(ids) and len(ids) == len(set(ids))
-        for required in ("TPU001", "TPU110", "TPU301", "TPU302", "TPU303",
-                         "TPU401", "TPU402"):
+        for required in ("TPU001", "TPU110", "TPU111", "TPU301", "TPU302",
+                         "TPU303", "TPU401", "TPU402"):
             assert required in ids
+
+    def test_tpu111_goodput_prefixes_have_a_sole_writer(self, tmp_path):
+        rogue = """
+            from mpi_operator_tpu.utils import metrics
+
+            dup = metrics.new_gauge(
+                "tpu_operator_job_goodput_ratio", "duplicate writer",
+                ("namespace", "tpujob"),
+            )
+            phase = metrics.new_counter(
+                "tpu_operator_job_phase_events_total", "prefix squatter",
+            )
+            fine = metrics.new_gauge("tpu_operator_other_gauge", "ok")
+        """
+        repo = view(tmp_path, rogue)
+        findings = framework.run(repo, select=["TPU111"])
+        assert sorted(f.message.split("(")[1].split(")")[0]
+                      for f in findings) == [
+            "'tpu_operator_job_goodput_ratio'",
+            "'tpu_operator_job_phase_events_total'",
+        ]
+        for f in findings:
+            assert "utils/goodput.py" in f.message
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,87 @@
+"""Smoke tier for the goodput-under-preemption benchmark
+(bench_goodput.py).
+
+The full acceptance run (100 jobs x kill rates 0/0.1/0.3) is `make
+bench-goodput`; the tier-1 smoke keeps the harness honest on every run:
+a small fleet must converge at every kill rate, the artifact must pass
+its own schema gate, the per-phase attribution must tile the wall clock
+within 1%, goodput must not *improve* under preemption, and the same
+seed must reproduce the document bit-for-bit.
+"""
+
+import json
+
+import pytest
+
+import bench_goodput as bench
+from mpi_operator_tpu.utils import goodput
+
+
+class TestBenchGoodputSmoke:
+    def test_curve_converges_and_schema_checks(self):
+        doc = bench.build_doc([0.0, 0.1, 0.3], jobs=40, seed=7)
+        bench.check_schema(doc)  # raises on any shape violation
+        assert [p["kill_rate"] for p in doc["curve"]] == [0.0, 0.1, 0.3]
+        for result in doc["results"]:
+            assert result["converged"] is True
+            assert result["outcomes"].get("Succeeded", 0) == 40
+            # Phase attribution tiles the fleet wall clock within 1%.
+            attributed = sum(result["phase_seconds"].values())
+            assert attributed == pytest.approx(
+                result["wall_seconds_total"],
+                rel=0.01,
+            )
+            assert result["attribution_residual_ratio"] <= 0.01
+        # Goodput under preemption never beats the undisturbed baseline.
+        ratios = [p["goodput_ratio"] for p in doc["curve"]]
+        assert ratios[0] >= ratios[-1]
+        # Chaos actually fired at the non-zero rates, and the phase
+        # taxonomy shows where the time went.
+        chaotic = doc["results"][-1]
+        assert chaotic["kills"] > 0 and chaotic["restarts_total"] > 0
+        assert chaotic["phase_seconds"][goodput.PHASE_RESTART_DOWNTIME] > 0
+        assert chaotic["loss_attribution_vs_baseline"][
+            goodput.PHASE_RESTART_DOWNTIME
+        ] > 0
+
+    def test_same_seed_bit_identical_document(self):
+        a = bench.build_doc([0.0, 0.2], jobs=30, seed=11)
+        b = bench.build_doc([0.0, 0.2], jobs=30, seed=11)
+        assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+    def test_baseline_has_no_kills_or_downtime(self):
+        result = bench.run_rate(0.0, jobs=24, seed=3)
+        assert result["converged"] and result["kills"] == 0
+        assert result["restarts_total"] == 0
+        assert result["phase_seconds"][goodput.PHASE_RESTART_DOWNTIME] == 0.0
+
+    def test_schema_check_rejects_missing_keys(self):
+        doc = bench.build_doc([0.0], jobs=24, seed=3)
+        del doc["results"][0]["phase_shares"]
+        with pytest.raises(ValueError, match="phase_shares"):
+            bench.check_schema(doc)
+
+    def test_schema_check_rejects_open_phase_vocabulary(self):
+        doc = bench.build_doc([0.0], jobs=24, seed=3)
+        doc["results"][0]["phase_seconds"]["coffee_break"] = 1.0
+        with pytest.raises(ValueError, match="vocabulary"):
+            bench.check_schema(doc)
+
+    def test_schema_check_rejects_attribution_gap(self):
+        doc = bench.build_doc([0.0], jobs=24, seed=3)
+        res = doc["results"][0]
+        res["phase_seconds"][goodput.PHASE_QUEUE_WAIT] += (
+            0.5 * res["wall_seconds_total"]
+        )
+        with pytest.raises(ValueError, match="deviates"):
+            bench.check_schema(doc)
+
+
+@pytest.mark.slow
+class TestBenchGoodputAcceptanceScale:
+    def test_100_jobs_full_curve_seed_42(self):
+        doc = bench.build_doc(list(bench.KILL_RATES), jobs=100, seed=42)
+        bench.check_schema(doc)
+        assert all(r["converged"] for r in doc["results"])
+        ratios = [p["goodput_ratio"] for p in doc["curve"]]
+        assert ratios[0] >= ratios[-1]

@@ -1,0 +1,415 @@
+"""Goodput ledger (utils/goodput.py): phase attribution over flight-
+recorder timelines, the telemetry checkpoint join, scrape-time metrics,
+the /debug goodput endpoints, and LRU behavior under max_jobs pressure.
+
+The load-bearing invariant everywhere: the closed phase vocabulary tiles
+the wall clock — phases are non-negative and sum to the wall time, for
+clean lifecycles, restart storms, and adversarial (skewed, shuffled,
+seeded-random) timelines alike.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mpi_operator_tpu.utils import flightrecorder, goodput, metrics
+
+COND = flightrecorder.CONDITION
+POD = flightrecorder.POD
+SCHED = flightrecorder.SCHEDULING
+
+
+class Timeline:
+    """Builds flight-recorder timelines against an injectable clock."""
+
+    def __init__(self, capacity=256, max_jobs=256):
+        self.t = [0.0]
+        self.fr = flightrecorder.FlightRecorder(
+            capacity_per_job=capacity, max_jobs=max_jobs,
+            clock=lambda: self.t[0],
+        )
+
+    def at(self, ts, ns, name, kind, **attrs):
+        self.t[0] = ts
+        return self.fr.record(ns, name, kind, **attrs)
+
+    def clean_job(self, ns="default", name="j"):
+        """Queue-wait 4s, scheduling 2s, pod-pending 2s, bootstrap 3s,
+        productive 18s => wall 29s, terminal."""
+        self.at(0, ns, name, COND, type="Created", status="True")
+        self.at(0, ns, name, COND, type="Suspended", status="True")
+        self.at(4, ns, name, COND, type="QuotaReserved", status="True")
+        self.at(6, ns, name, SCHED, reason="Scheduled")
+        self.at(8, ns, name, POD, phase="Running", pod=f"{name}-worker-0")
+        self.at(11, ns, name, COND, type="Running", status="True")
+        self.at(29, ns, name, COND, type="Succeeded", status="True")
+
+
+def phase_sum(phases: dict) -> float:
+    return sum(phases[p] for p in goodput.GOODPUT_PHASES)
+
+
+class TestAttributeTimeline:
+    def test_clean_lifecycle_tiles_the_wall_clock(self):
+        tl = Timeline()
+        tl.clean_job()
+        att = goodput.attribute_timeline(tl.fr.timeline("default", "j"))
+        assert att["terminal"] and att["restarts"] == 0
+        assert att["wall_seconds"] == pytest.approx(29.0)
+        p = att["phases"]
+        assert p[goodput.PHASE_QUEUE_WAIT] == pytest.approx(4.0)
+        assert p[goodput.PHASE_SCHEDULING] == pytest.approx(2.0)
+        assert p[goodput.PHASE_POD_PENDING] == pytest.approx(2.0)
+        assert p[goodput.PHASE_BOOTSTRAP] == pytest.approx(3.0)
+        assert p[goodput.PHASE_PRODUCTIVE] == pytest.approx(18.0)
+        assert phase_sum(p) == pytest.approx(att["wall_seconds"])
+
+    def test_restart_cycle_counts_and_charges_downtime(self):
+        tl = Timeline()
+        tl.at(0, "d", "j", SCHED, reason="Scheduled")
+        tl.at(1, "d", "j", POD, phase="Running")
+        tl.at(2, "d", "j", COND, type="Running", status="True")
+        tl.at(10, "d", "j", POD, phase="Failed", exit_code=137)
+        tl.at(10, "d", "j", COND, type="Restarting", status="True")
+        tl.at(15, "d", "j", COND, type="Running", status="True")
+        tl.at(20, "d", "j", COND, type="Succeeded", status="True")
+        att = goodput.attribute_timeline(tl.fr.timeline("d", "j"))
+        assert att["restarts"] == 1 and att["terminal"]
+        p = att["phases"]
+        assert p[goodput.PHASE_RESTART_DOWNTIME] == pytest.approx(5.0)
+        assert p[goodput.PHASE_PRODUCTIVE] == pytest.approx(13.0)
+        assert phase_sum(p) == pytest.approx(att["wall_seconds"]) == 20.0
+
+    def test_live_job_charges_current_state_up_to_now(self):
+        tl = Timeline()
+        tl.at(0, "d", "j", SCHED, reason="Scheduled")
+        tl.at(2, "d", "j", COND, type="Running", status="True")
+        att = goodput.attribute_timeline(tl.fr.timeline("d", "j"), now=12.0)
+        assert not att["terminal"]
+        assert att["phases"][goodput.PHASE_PRODUCTIVE] == pytest.approx(10.0)
+        assert att["wall_seconds"] == pytest.approx(12.0)
+
+    def test_terminal_freezes_the_clock(self):
+        tl = Timeline()
+        tl.clean_job()
+        # Post-mortem entries and a later `now` never extend the wall.
+        tl.at(40, "default", "j", POD, phase="Succeeded")
+        att = goodput.attribute_timeline(
+            tl.fr.timeline("default", "j"), now=1000.0
+        )
+        assert att["terminal"] and att["wall_seconds"] == pytest.approx(29.0)
+
+    def test_preemption_scheduling_decision_is_downtime(self):
+        tl = Timeline()
+        tl.at(0, "d", "j", SCHED, reason="Scheduled")
+        tl.at(1, "d", "j", COND, type="Running", status="True")
+        tl.at(5, "d", "j", SCHED, reason="Preempted")
+        att = goodput.attribute_timeline(tl.fr.timeline("d", "j"), now=8.0)
+        assert att["restarts"] == 1
+        assert att["phases"][goodput.PHASE_RESTART_DOWNTIME] == pytest.approx(3.0)
+
+    def test_empty_timeline_is_all_zero(self):
+        att = goodput.attribute_timeline([])
+        assert att["wall_seconds"] == 0.0 and not att["terminal"]
+        assert phase_sum(att["phases"]) == 0.0
+
+    def test_backwards_clock_never_goes_negative(self):
+        entries = [
+            {"seq": 1, "ts": 10.0, "kind": COND, "type": "Running",
+             "status": "True"},
+            {"seq": 2, "ts": 3.0, "kind": POD, "phase": "Failed"},  # skew
+            {"seq": 3, "ts": 12.0, "kind": COND, "type": "Succeeded",
+             "status": "True"},
+        ]
+        att = goodput.attribute_timeline(entries)
+        assert all(v >= 0.0 for v in att["phases"].values())
+        assert phase_sum(att["phases"]) == pytest.approx(att["wall_seconds"])
+
+    # -- property-style: adversarial seeded timelines --------------------
+
+    def _random_entry(self, rng: random.Random, seq: int, ts: float) -> dict:
+        kind = rng.choice((COND, POD, SCHED, flightrecorder.EVENT))
+        entry = {"seq": seq, "ts": round(ts, 6), "kind": kind}
+        if kind == COND:
+            entry["type"] = rng.choice((
+                "Created", "Suspended", "QuotaReserved", "QueueNotFound",
+                "Scheduled", "Running", "Restarting", "Succeeded", "Failed",
+            ))
+            entry["status"] = rng.choice(("True", "False"))
+        elif kind == POD:
+            entry["phase"] = rng.choice(
+                ("Pending", "Running", "Succeeded", "Failed")
+            )
+        elif kind == SCHED:
+            entry["reason"] = rng.choice(
+                ("Scheduled", "FailedScheduling", "Preempted")
+            )
+        return entry
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_chaos_timelines_phases_tile_wall_time(self, seed):
+        rng = random.Random(seed)
+        ts = 0.0
+        entries = []
+        for seq in range(1, rng.randint(5, 60)):
+            # Mostly forward time, occasional skew backwards.
+            ts += rng.uniform(-0.5, 3.0)
+            entries.append(self._random_entry(rng, seq, max(ts, 0.0)))
+        shuffled = list(entries)
+        rng.shuffle(shuffled)  # seq order is authoritative, not list order
+        now = ts + rng.uniform(0.0, 10.0)
+        att = goodput.attribute_timeline(shuffled, now=now)
+        assert all(v >= 0.0 for v in att["phases"].values())
+        assert phase_sum(att["phases"]) == pytest.approx(
+            att["wall_seconds"], abs=1e-6
+        )
+        assert att["wall_seconds"] >= 0.0
+
+
+class TestGoodputLedger:
+    def _ledger(self, tl: Timeline, registry=None):
+        return goodput.GoodputLedger(
+            tl.fr, registry=registry, clock=lambda: tl.t[0]
+        )
+
+    def test_job_snapshot_shapes_and_ratio(self):
+        tl = Timeline()
+        tl.clean_job()
+        ledger = self._ledger(tl)
+        snap = ledger.job_snapshot("default", "j")
+        assert snap["goodput_ratio"] == pytest.approx(18.0 / 29.0, abs=1e-6)
+        assert set(snap["phases"]) == set(goodput.GOODPUT_PHASES)
+        assert phase_sum(snap["phases"]) == pytest.approx(
+            snap["wall_seconds"], abs=1e-5
+        )
+        assert snap["phase_shares"][goodput.PHASE_PRODUCTIVE] == (
+            pytest.approx(18.0 / 29.0, abs=1e-6)
+        )
+
+    def test_unknown_job_snapshot_is_none(self):
+        tl = Timeline()
+        assert self._ledger(tl).job_snapshot("default", "ghost") is None
+
+    def test_telemetry_join_carves_checkpoint_from_productive(self):
+        tl = Timeline()
+        tl.clean_job()
+        ledger = self._ledger(tl)
+        ledger.observe_telemetry("default", "j", {
+            "event": "train_telemetry", "step": 100, "checkpoint_s": 4.0,
+        })
+        snap = ledger.job_snapshot("default", "j")
+        assert snap["phases"][goodput.PHASE_CHECKPOINT] == pytest.approx(4.0)
+        assert snap["phases"][goodput.PHASE_PRODUCTIVE] == pytest.approx(14.0)
+        # The carve moves time *within* the wall; the sum is unchanged.
+        assert phase_sum(snap["phases"]) == pytest.approx(29.0, abs=1e-5)
+        assert snap["goodput_ratio"] == pytest.approx(14.0 / 29.0, abs=1e-6)
+
+    def test_checkpoint_carve_capped_at_productive(self):
+        tl = Timeline()
+        tl.clean_job()
+        ledger = self._ledger(tl)
+        ledger.observe_telemetry("default", "j", {"checkpoint_s": 9999.0})
+        snap = ledger.job_snapshot("default", "j")
+        assert snap["phases"][goodput.PHASE_PRODUCTIVE] == 0.0
+        assert snap["phases"][goodput.PHASE_CHECKPOINT] == pytest.approx(18.0)
+        assert phase_sum(snap["phases"]) == pytest.approx(29.0, abs=1e-5)
+
+    def test_fleet_snapshot_aggregates(self):
+        tl = Timeline()
+        tl.clean_job(name="a")
+        tl.clean_job(name="b")
+        tl.at(0, "default", "live", SCHED, reason="Scheduled")
+        tl.at(1, "default", "live", COND, type="Running", status="True")
+        tl.t[0] = 30.0
+        fleet = self._ledger(tl).fleet_snapshot()
+        assert fleet["job_count"] == 3 and fleet["terminal_jobs"] == 2
+        # a+b: 18/29 productive each; live: 29/30 productive.
+        expect = (18.0 + 18.0 + 29.0) / (29.0 + 29.0 + 30.0)
+        assert fleet["goodput_ratio"] == pytest.approx(expect, abs=1e-4)
+        assert phase_sum(fleet["phase_seconds"]) == pytest.approx(
+            fleet["wall_seconds"], abs=1e-4
+        )
+        assert {j["name"] for j in fleet["jobs"]} == {"a", "b", "live"}
+
+    def test_scrape_sets_gauges_and_finalizes_terminal_jobs_once(self):
+        tl = Timeline()
+        registry = metrics.Registry()
+        ledger = self._ledger(tl, registry=registry)
+        tl.clean_job()
+        registry.expose()
+        assert ledger.goodput_ratio.value("default", "j") == (
+            pytest.approx(18.0 / 29.0, abs=1e-6)
+        )
+        assert ledger.fleet_goodput.value() == pytest.approx(
+            18.0 / 29.0, abs=1e-6
+        )
+        assert ledger.fleet_phase_seconds.value(
+            goodput.PHASE_QUEUE_WAIT
+        ) == pytest.approx(4.0)
+        # Terminal job lands in the per-phase histograms exactly once,
+        # no matter how many scrapes happen afterwards.
+        registry.expose()
+        registry.expose()
+        for phase in goodput.GOODPUT_PHASES:
+            assert ledger.phase_seconds.sample_count(phase) == 1
+        assert ledger.phase_seconds.sample_sum(
+            goodput.PHASE_PRODUCTIVE
+        ) == pytest.approx(18.0)
+
+    def test_scrape_drops_series_for_evicted_jobs(self):
+        tl = Timeline()
+        registry = metrics.Registry()
+        ledger = self._ledger(tl, registry=registry)
+        tl.clean_job()
+        ledger.observe_telemetry("default", "j", {"checkpoint_s": 1.0})
+        registry.expose()
+        tl.fr.forget("default", "j")
+        exposition = registry.expose()
+        assert 'tpujob="j"' not in exposition
+        # Internal join tables pruned with the recorder (no leaks).
+        assert ledger._telemetry == {} and ledger._finalized == set()
+
+
+class TestLedgerUnderLRUPressure:
+    """Satellite: the ledger rides the recorder's max_jobs LRU — evicted
+    jobs disappear from snapshots, metrics, and the endpoints; survivors
+    keep exact attribution."""
+
+    def test_eviction_under_pressure_keeps_newest_jobs(self):
+        tl = Timeline(max_jobs=4)
+        ledger = goodput.GoodputLedger(tl.fr, clock=lambda: tl.t[0])
+        for i in range(10):
+            tl.clean_job(name=f"j{i}")
+        assert len(tl.fr) == 4
+        for i in range(6):
+            assert ledger.job_snapshot("default", f"j{i}") is None
+        for i in range(6, 10):
+            snap = ledger.job_snapshot("default", f"j{i}")
+            assert snap is not None
+            assert snap["goodput_ratio"] == pytest.approx(
+                18.0 / 29.0, abs=1e-6
+            )
+        fleet = ledger.fleet_snapshot()
+        assert fleet["job_count"] == 4
+        assert {j["name"] for j in fleet["jobs"]} == {
+            "j6", "j7", "j8", "j9"
+        }
+
+    def test_recording_touch_protects_active_jobs(self):
+        tl = Timeline(max_jobs=2)
+        tl.at(0, "d", "old-active", COND, type="Running", status="True")
+        tl.at(1, "d", "idle", COND, type="Running", status="True")
+        # A fresh entry for the older job makes it most-recently-used...
+        tl.at(2, "d", "old-active", POD, phase="Failed")
+        # ...so the newcomer evicts the idle one instead.
+        tl.at(3, "d", "new", COND, type="Running", status="True")
+        assert tl.fr.timeline("d", "idle") is None
+        assert tl.fr.timeline("d", "old-active") is not None
+        assert tl.fr.timeline("d", "new") is not None
+
+    def test_attribution_invariant_survives_ring_truncation(self):
+        # capacity_per_job smaller than the entry count: the ring keeps
+        # only the tail; phases must still tile the (shorter) wall.
+        tl = Timeline(capacity=8)
+        for i in range(30):
+            tl.at(float(i), "d", "j", COND,
+                  type=("Running" if i % 2 else "Restarting"), status="True")
+        att = goodput.attribute_timeline(tl.fr.timeline("d", "j"), now=40.0)
+        assert phase_sum(att["phases"]) == pytest.approx(
+            att["wall_seconds"], abs=1e-6
+        )
+        assert att["wall_seconds"] == pytest.approx(40.0 - 22.0)
+
+
+def _monitoring_server(**attrs):
+    from http.server import ThreadingHTTPServer
+
+    from mpi_operator_tpu.cmd.operator import _MonitoringHandler
+    from mpi_operator_tpu.utils import trace
+
+    defaults = {
+        "registry": metrics.Registry(),
+        "tracer": trace.Tracer(),
+        "flight_recorder": None,
+        "goodput_ledger": None,
+        "health_fn": staticmethod(lambda: True),
+    }
+    defaults.update(attrs)
+    handler = type("H", (_MonitoringHandler,), defaults)
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+class TestGoodputEndpoints:
+    def _stack(self):
+        tl = Timeline()
+        tl.clean_job()
+        ledger = goodput.GoodputLedger(tl.fr, clock=lambda: tl.t[0])
+        return tl, ledger
+
+    def test_per_job_goodput_page(self):
+        tl, ledger = self._stack()
+        server, base = _monitoring_server(
+            flight_recorder=tl.fr, goodput_ledger=ledger
+        )
+        try:
+            resp = urllib.request.urlopen(
+                base + "/debug/jobs/default/j/goodput", timeout=5
+            )
+            assert resp.headers["Content-Type"] == "application/json"
+            snap = json.loads(resp.read().decode())
+            assert snap["name"] == "j" and snap["terminal"]
+            assert snap["goodput_ratio"] == pytest.approx(
+                18.0 / 29.0, abs=1e-6
+            )
+            assert phase_sum(snap["phases"]) == pytest.approx(
+                snap["wall_seconds"], abs=1e-4
+            )
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_fleet_rollup_page(self):
+        tl, ledger = self._stack()
+        server, base = _monitoring_server(
+            flight_recorder=tl.fr, goodput_ledger=ledger
+        )
+        try:
+            resp = urllib.request.urlopen(base + "/debug/goodput", timeout=5)
+            fleet = json.loads(resp.read().decode())
+            assert fleet["job_count"] == 1 and fleet["terminal_jobs"] == 1
+            assert fleet["jobs"][0]["name"] == "j"
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_unknown_job_and_missing_ledger_404(self):
+        tl, ledger = self._stack()
+        server, base = _monitoring_server(
+            flight_recorder=tl.fr, goodput_ledger=ledger
+        )
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(
+                    base + "/debug/jobs/default/ghost/goodput", timeout=5
+                )
+            assert exc_info.value.code == 404
+        finally:
+            server.shutdown()
+            server.server_close()
+        server, base = _monitoring_server(goodput_ledger=None)
+        try:
+            for path in ("/debug/jobs/default/j/goodput", "/debug/goodput"):
+                with pytest.raises(urllib.error.HTTPError) as exc_info:
+                    urllib.request.urlopen(base + path, timeout=5)
+                assert exc_info.value.code == 404
+        finally:
+            server.shutdown()
+            server.server_close()

@@ -1139,6 +1139,63 @@ class TestTimelineEndpoint:
             server.shutdown()
             server.server_close()
 
+    def _filter_fixture(self):
+        t = [0.0]
+        fr = flightrecorder.FlightRecorder(clock=lambda: t[0])
+        for i in range(5):
+            t[0] = float(i)
+            fr.record("default", "j1", flightrecorder.EVENT, reason=f"e{i}")
+        t[0] = 9.0
+        fr.record("default", "j1", flightrecorder.POD,
+                  reason="Running", phase="Running")
+        return fr
+
+    def test_limit_and_kind_query_filters(self):
+        server, base = _monitoring_server(
+            flight_recorder=self._filter_fixture()
+        )
+        try:
+            def fetch(query):
+                resp = urllib.request.urlopen(
+                    base + "/debug/jobs/default/j1/timeline" + query,
+                    timeout=5,
+                )
+                return json.loads(resp.read().decode())["entries"]
+
+            # limit keeps the newest N (the post-mortem tail).
+            assert [e["reason"] for e in fetch("?limit=2")] == [
+                "e4", "Running"
+            ]
+            # kind filters before the limit applies.
+            assert [e["reason"] for e in fetch("?kind=event&limit=2")] == [
+                "e3", "e4"
+            ]
+            assert [e["reason"] for e in fetch("?kind=pod")] == ["Running"]
+            # A kind with no entries is an empty timeline, not a 404.
+            assert fetch("?kind=condition") == []
+            assert len(fetch("")) == 6
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_malformed_query_values_400(self):
+        server, base = _monitoring_server(
+            flight_recorder=self._filter_fixture()
+        )
+        try:
+            for query in ("?limit=zero", "?limit=0", "?limit=-3",
+                          "?limit=", "?kind=bogus", "?kind="):
+                with pytest.raises(urllib.error.HTTPError) as exc_info:
+                    urllib.request.urlopen(
+                        base + "/debug/jobs/default/j1/timeline" + query,
+                        timeout=5,
+                    )
+                assert exc_info.value.code == 400, query
+                assert b"bad request" in exc_info.value.read()
+        finally:
+            server.shutdown()
+            server.server_close()
+
 
 # ---------------------------------------------------------------------------
 # End-to-end acceptance: one trace id across operator/launcher/worker and
