@@ -47,6 +47,7 @@ lint: verify-manifests
 # See docs/static-analysis.md.
 analyze:
 	$(PYTHON) hack/analyze.py --format json --fail-on-new
+	$(PYTHON) hack/analyze.py --select TPU5 --fail-on-new
 
 # Runtime base image (reference analog: Makefile:101-108 builds + e2e-
 # runs its images). Runs wherever a container runtime exists; this

@@ -112,25 +112,33 @@ def _timed_steps(step, state, args_rest, steps: int, warmup: int):
     On honest platforms this is identical to plain timing (both windows
     end in a readback barrier, which costs microseconds locally).
     """
+    from mpi_operator_tpu.utils import jaxtrace
+
     for _ in range(warmup):
         state = step(*state, *args_rest)
     _sync(state)
+    # Compiles/transfers past this barrier are hot-path regressions the
+    # jit/transfer tracer (when armed) splits out of the warmup totals.
+    jaxtrace.note_warmup_complete()
     if steps == 0:  # warmup-only call (profiling path)
         return state, float("nan")
     if steps < 4:  # too short for two windows; single window + barrier
         t0 = time.perf_counter()
         for _ in range(steps):
             state = step(*state, *args_rest)
+            jaxtrace.note_step()
         _sync(state)
         return state, (time.perf_counter() - t0) / steps
     n1 = max(steps // 4, 1)
     t0 = time.perf_counter()
     for _ in range(n1):
         state = step(*state, *args_rest)
+        jaxtrace.note_step()
     _sync(state)
     t1 = time.perf_counter()
     for _ in range(steps):
         state = step(*state, *args_rest)
+        jaxtrace.note_step()
     _sync(state)
     t2 = time.perf_counter()
     sec = ((t2 - t1) - (t1 - t0)) / (steps - n1)
@@ -1418,6 +1426,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument("--profile-dir", default="")
+    parser.add_argument("--jax-trace", action="store_true",
+                        help="arm the jit-recompile / host-transfer "
+                             "tracer (utils/jaxtrace, also armed by "
+                             "TPU_JAX_TRACE=1) and attach its report to "
+                             "each suite's result block as 'jax_trace'")
     parser.add_argument("--perf-md", default="",
                         help="append results as a markdown table row file")
     return parser
@@ -1425,6 +1438,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main() -> int:
     args = build_parser().parse_args()
+
+    # Light import (hooks/jax load only on enable); TPU_JAX_TRACE=1 in
+    # the environment armed it at import already.
+    from mpi_operator_tpu.utils import jaxtrace
+
+    if args.jax_trace and not jaxtrace.enabled():
+        jaxtrace.enable()
 
     try:
         timeout_s = float(os.environ.get("BENCH_BACKEND_TIMEOUT_S", "180"))
@@ -1469,7 +1489,13 @@ def main() -> int:
         for name, fn in SUITES.items():
             log(f"=== suite: {name} ===")
             try:
+                if jaxtrace.enabled():
+                    jaxtrace.enable()  # fresh tracer: per-suite counts
                 results[name] = fn(args)
+                if jaxtrace.enabled():
+                    results[name]["jax_trace"] = (
+                        jaxtrace.tracer().report()
+                    )
             except Exception as e:  # noqa: BLE001 - one suite must not
                 # take down the rest of the capture (a llama OOM on a
                 # 16G chip aborted a whole round-3 run before this).
@@ -1497,7 +1523,10 @@ def main() -> int:
         # though the completed suites were logged above.
         return 1 if failed else 0
 
-    print(json.dumps(SUITES[args.suite](args)))
+    result = SUITES[args.suite](args)
+    if jaxtrace.enabled():
+        result["jax_trace"] = jaxtrace.tracer().report()
+    print(json.dumps(result))
     return 0
 
 

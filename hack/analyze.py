@@ -35,7 +35,11 @@ DEFAULT_BASELINE = REPO / "hack" / "analysis_baseline.json"
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--format", choices=("text", "json", "github"),
+                        default="text",
+                        help="github emits Actions workflow annotations "
+                             "(::error for new findings, ::notice for "
+                             "baselined) so CI failures are clickable")
     parser.add_argument("--fail-on-new", action="store_true",
                         help="exit 1 when findings exceed the baseline")
     parser.add_argument("--update-baseline", action="store_true",
@@ -54,6 +58,14 @@ def main(argv=None) -> int:
             print(f"{r.id}{alias_txt}  {r.name}: {r.description}")
         return 0
 
+    # The registry gate: a rule module silently dropping out of the
+    # import chain must fail loudly, not pass with fewer rules.
+    missing = framework.missing_rule_families()
+    if missing:
+        print("analyze: FATAL required rule families missing from the "
+              f"registry: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
     select = [s.strip() for s in args.select.split(",") if s.strip()] or None
     repo = framework.RepoView(args.root)
     findings = framework.run(repo, select=select)
@@ -63,9 +75,18 @@ def main(argv=None) -> int:
         # subset would silently drop every other family's legacy keys.
         if select:
             findings = framework.run(repo)
+        old = framework.load_baseline(args.baseline)
+        new = framework.baseline_payload(findings)["findings"]
         framework.write_baseline(args.baseline, findings)
+        added = sorted(k for k in new if k not in old)
+        removed = sorted(k for k in old if k not in new)
         print(f"baseline: wrote {len(findings)} finding(s) to "
-              f"{args.baseline}")
+              f"{args.baseline} (+{len(added)} added, "
+              f"-{len(removed)} stale)")
+        for key in added:
+            print(f"  + {key}")
+        for key in removed:
+            print(f"  - {key}")
         return 0
 
     baseline = framework.load_baseline(args.baseline)
@@ -73,7 +94,16 @@ def main(argv=None) -> int:
     syntax = [f for f in findings
               if f.rule_id == framework.SYNTAX_RULE_ID]
 
-    if args.format == "json":
+    if args.format == "github":
+        # GitHub Actions workflow commands: new findings annotate the
+        # diff as errors; baselined ones stay visible as notices.
+        for f in findings:
+            level = "error" if f in fresh else "notice"
+            print(f"::{level} file={f.file},line={f.line},"
+                  f"title={f.rule_id}::{f.message}")
+        print(f"analyze: {len(repo.files)} files, {len(findings)} "
+              f"finding(s), {len(fresh)} new vs baseline")
+    elif args.format == "json":
         print(json.dumps({
             "files": len(repo.files),
             "rules": [r.id for r in framework.all_rules()],

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import ast
 import json
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, Iterable, Optional
 
@@ -150,6 +150,151 @@ class RepoView:
         return pkg or self.files
 
 
+# ----------------------------------------------------------------------
+# Shared call-graph machinery
+#
+# lockcheck grew this first (intra-class "which locks are held on
+# entry" inference); the jaxcheck family needs the same two fixpoint
+# shapes over a *module-level* call graph (which helpers are reachable
+# from a train step), so both live here and the checkers stay thin.
+# ----------------------------------------------------------------------
+
+
+def union_fixpoint(
+    seed: dict, edges: dict
+) -> dict:
+    """Least fixpoint of ``acc[k] = seed[k] | U(acc[d] for d in
+    edges[k])`` — transitive accumulation along call edges (lockcheck's
+    may-acquire sets; generic transitive closure)."""
+    acc = {k: frozenset(v) for k, v in seed.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k in acc:
+            v = acc[k]
+            for dep in edges.get(k, ()):
+                v = v | acc.get(dep, frozenset())
+            if v != acc[k]:
+                acc[k] = v
+                changed = True
+    return acc
+
+
+def intersect_fixpoint(entry: dict, call_sites: dict) -> dict:
+    """Greatest fixpoint of ``entry[k] = &((entry[caller] | extra) for
+    (caller, extra) in call_sites[k])`` — "provably true on EVERY
+    entry" inference (lockcheck's held-on-entry sets).  Keys whose
+    entry set is already empty are external entry points and never
+    shrink further."""
+    entry = dict(entry)
+    changed = True
+    while changed:
+        changed = False
+        for name, sites in call_sites.items():
+            if not entry.get(name):
+                continue
+            acc = entry[name]
+            for caller, extra in sites:
+                acc = acc & (entry.get(caller, frozenset()) | extra)
+            if acc != entry[name]:
+                entry[name] = acc
+                changed = True
+    return entry
+
+
+@dataclass
+class FunctionNode:
+    """One function/method/closure definition in a module call graph."""
+
+    name: str            # simple name
+    qualname: str        # dotted lexical path ("Cls.m", "make.step")
+    node: ast.AST        # the FunctionDef / AsyncFunctionDef
+    lineno: int
+    parent: Optional[str] = None   # enclosing def's qualname
+    in_loop: bool = False          # defined lexically inside for/while
+    calls: list = field(default_factory=list)  # (callee simple name, line)
+
+
+class ModuleGraph:
+    """Module-level call graph: every def (including closures and
+    methods) plus simple-name call edges.  Resolution is by simple name
+    — a heuristic vet, not a prover, matching lockcheck's contract."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.functions: list[FunctionNode] = []
+        self.by_name: dict[str, list[FunctionNode]] = {}
+        if sf.tree is not None:
+            self._collect(sf.tree, parent=None, in_loop=False)
+
+    def _collect(self, node: ast.AST, parent: Optional[str],
+                 in_loop: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = (f"{parent}.{child.name}" if parent else child.name)
+                fn = FunctionNode(
+                    child.name, qual, child, child.lineno, parent, in_loop)
+                fn.calls = self._direct_calls(child)
+                self.functions.append(fn)
+                self.by_name.setdefault(child.name, []).append(fn)
+                self._collect(child, parent=qual, in_loop=in_loop)
+            elif isinstance(child, ast.ClassDef):
+                self._collect(child, parent=child.name, in_loop=in_loop)
+            else:
+                looped = in_loop or isinstance(
+                    child, (ast.For, ast.AsyncFor, ast.While))
+                self._collect(child, parent=parent, in_loop=looped)
+
+    @staticmethod
+    def _direct_calls(fn_node: ast.AST) -> list:
+        """(simple callee name, lineno) pairs in this def's own body —
+        nested defs keep their calls (they are their own nodes)."""
+        out = []
+        stack = [c for c in ast.iter_child_nodes(fn_node)]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Call):
+                f = node.func
+                callee = (
+                    f.id if isinstance(f, ast.Name)
+                    else f.attr if isinstance(f, ast.Attribute) else None
+                )
+                if callee:
+                    out.append((callee, node.lineno))
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def reachable(self, roots: Iterable[FunctionNode],
+                  stop: Optional[Callable[[FunctionNode], bool]] = None,
+                  ) -> list[FunctionNode]:
+        """Defs reachable from ``roots`` via simple-name call edges.
+        ``stop`` prunes traversal *through* a node (it is still
+        returned) — jaxcheck stops at jitted boundaries, where implicit
+        host transfers cannot hide."""
+        seen: dict[str, FunctionNode] = {}
+        frontier = list(roots)
+        while frontier:
+            fn = frontier.pop()
+            if fn.qualname in seen:
+                continue
+            seen[fn.qualname] = fn
+            if stop is not None and stop(fn):
+                continue
+            for callee, _ in fn.calls:
+                frontier.extend(self.by_name.get(callee, ()))
+        return sorted(seen.values(), key=lambda f: f.lineno)
+
+
+def module_graph(sf: SourceFile) -> ModuleGraph:
+    """The (cached) call graph of one source file."""
+    cached = getattr(sf, "_module_graph", None)
+    if cached is None:
+        cached = sf._module_graph = ModuleGraph(sf)
+    return cached
+
+
 @dataclass(frozen=True)
 class Rule:
     id: str
@@ -171,11 +316,31 @@ def rule(rule_id: str, name: str, description: str):
     return register
 
 
+# Every rule family the analyzer ships.  A refactor that drops a rule
+# module import would silently lose a whole family; the lint gate and
+# hack/analyze.py both assert this registry is fully populated.
+REQUIRED_RULE_FAMILIES = {
+    "TPU0": "style (hack/lint.py heritage)",
+    "TPU1": "metrics discipline",
+    "TPU2": "hygiene",
+    "TPU3": "sole-writer",
+    "TPU4": "lock discipline",
+    "TPU5": "jax perf-correctness",
+}
+
+
 def all_rules() -> list[Rule]:
     """Every registered rule, importing the rule modules on first use."""
     # Importing the rule modules registers their rules.
-    from . import lockcheck, rules  # noqa: F401
+    from . import jaxcheck, lockcheck, rules  # noqa: F401
     return [_RULES[k] for k in sorted(_RULES)]
+
+
+def missing_rule_families() -> list[str]:
+    """Required family prefixes with no registered rule (should be
+    empty; non-empty means a rule module stopped being imported)."""
+    present = {r.id[:4] for r in all_rules()}
+    return sorted(p for p in REQUIRED_RULE_FAMILIES if p not in present)
 
 
 def run(repo: RepoView, select: Optional[Iterable[str]] = None) -> list[Finding]:

@@ -1,0 +1,800 @@
+"""JAX perf-correctness rules (TPU5xx): the training-stack analog of
+the control-plane lock checker.
+
+The MLPerf TPU-v3 pod paper (arxiv 1909.09756) and the TPU concurrency
+study (arxiv 2011.03641) both attribute large step-time regressions to
+two silent bug classes: recompilation (a jit cache miss per step) and
+host<->device synchronization (a transfer barrier inside the step
+loop).  Neither crashes; both flatten throughput.  These rules catch
+the AST shapes that cause them before a bench does:
+
+**TPU501 — static-looking jit parameter.**  A ``jax.jit``-ed function
+whose signature carries a Python-scalar/shape/dict-shaped parameter
+(``int``/``bool``/``str``/``tuple``/``dict`` annotation or literal
+default) that is not listed in ``static_argnums``/``static_argnames``.
+Traced, such a value either concretizes (a TracerError at best) or
+becomes a silent retrace-per-value recompile.
+
+**TPU502 — jit under reconstruction.**  ``jax.jit(...)`` evaluated
+inside a loop body, or inside a per-step closure (a ``*_step``/
+``step_fn`` function): every evaluation wraps a fresh function object,
+so the jit cache misses every time — the "compiles forever" failure
+mode.
+
+**TPU503 — implicit host transfer on the step path.**  Within a step
+root (a ``train_step``/``eval_step``/``step_fn`` def) and every
+same-module helper reachable from it (the shared
+``framework.module_graph`` call-graph pass; traversal stops at jitted
+boundaries, where a transfer cannot hide): ``float()``/``int()``/
+``.item()``/``.tolist()``/``np.asarray()``/``print()`` on non-constant
+values.  Inside jit-ed roots the check narrows to conversions applied
+directly to traced parameters.  The sanctioned spelling —
+``jax.device_get(...)`` at a step boundary — is recognized and exempt.
+
+**TPU504 — donated buffer reused.**  A positional argument donated via
+``donate_argnums`` is read again after the call (donation invalidates
+the buffer), or is re-donated every loop iteration without being
+rebound from the call's result.
+
+**TPU505 — train step without donation.**  A train/update step jitted
+without ``donate_argnums``/``donate_argnames`` carries params and
+optimizer state twice in HBM (the old operand and the new result) —
+the classic 2x-memory step.
+
+**TPU506 — host sync in a hot loop.**  A loop that invokes a jitted
+callable (or a ``step_fn``-shaped method) and converts device values
+with ``float()``/``int()``/``.item()``/``np.asarray()`` in the same
+body forces a device round-trip per iteration.
+
+**TPU507 — pallas tile hygiene.**  Kernel entry points under ``ops/``
+must take their grid/tile defaults from the shared tile-selection
+plumbing in ``ops/_common.py`` (named constants + ``clamp_tile``), not
+private numeric literals — the contract the admission-time kernel
+autotuner will override per geometry.
+
+Like lockcheck, every rule is a heuristic vet, not a prover; false
+positives belong in the baseline workflow, not in rule silencing.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from .framework import (
+    Finding,
+    RepoView,
+    SourceFile,
+    module_graph,
+    rule,
+)
+
+# Step roots: the names the training stack gives its per-step
+# callables (models' inner defs, cmd/train's workload closures).
+STEP_NAME_RE = re.compile(r"^(train|eval|update|test)_step$|^step(_fn)?$")
+# Train/update steps carry optimizer state and should donate it; eval
+# steps deliberately excluded (donating params during eval is wrong).
+TRAIN_STEP_RE = re.compile(r"^(make_)?(train|update)_step$")
+STEP_FACTORY_RE = re.compile(r"^make_\w*step$")
+
+TILE_PARAM_RE = re.compile(r"^(block|tile)_[a-z0-9]+$")
+TILE_CONST_RE = re.compile(r"^(DEFAULT_)?(BLOCK|TILE)_[A-Z0-9_]+$")
+
+_JIT_NAMES = {"jit", "pjit"}
+_NP_ROOTS = {"np", "numpy", "onp"}
+_STATIC_LOOKING_ANNOTATIONS = {
+    "int", "bool", "str", "dict", "Dict", "tuple", "Tuple", "Sequence",
+    "Shape",
+}
+_CONVERTERS = {"float", "int", "bool"}
+_ITEM_METHODS = {"item", "tolist"}
+
+
+def _callee(call: ast.Call) -> tuple[str, str]:
+    """(root, name) of the callee: ``jax.jit`` -> ("jax", "jit");
+    bare ``jit`` -> ("", "jit")."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        root = fn.value
+        return (root.id if isinstance(root, ast.Name) else "", fn.attr)
+    if isinstance(fn, ast.Name):
+        return ("", fn.id)
+    return ("", "")
+
+
+def _is_jit_expr(expr: ast.AST) -> bool:
+    """expr names jax.jit/pjit (a decorator, or a Call's .func)."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in _JIT_NAMES
+    if isinstance(expr, ast.Name):
+        return expr.id in _JIT_NAMES
+    return False
+
+
+def _is_device_get(call: ast.Call) -> bool:
+    _, name = _callee(call)
+    return name == "device_get"
+
+
+def _literal_ints(node: Optional[ast.AST]) -> tuple[frozenset, bool]:
+    """(values, resolved) for an argnums literal: int or tuple/list of
+    ints.  resolved=False means the value is dynamic (a variable) and
+    the rule must not assume it knows the static set."""
+    if node is None:
+        return frozenset(), True
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return frozenset({node.value}), True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = set()
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                vals.add(e.value)
+            else:
+                return frozenset(), False
+        return frozenset(vals), True
+    return frozenset(), False
+
+
+def _literal_strs(node: Optional[ast.AST]) -> tuple[frozenset, bool]:
+    if node is None:
+        return frozenset(), True
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return frozenset({node.value}), True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = set()
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                vals.add(e.value)
+            else:
+                return frozenset(), False
+        return frozenset(vals), True
+    return frozenset(), False
+
+
+@dataclass
+class JitSite:
+    """One jax.jit application: a call expression or a decorator."""
+
+    lineno: int
+    target: Optional[str] = None       # name the jitted callable binds to
+    fn_name: Optional[str] = None      # wrapped function's simple name
+    factory_name: Optional[str] = None  # jax.jit(make_x_step(...)) shape
+    static_argnums: frozenset = frozenset()
+    static_argnames: frozenset = frozenset()
+    donate_argnums: frozenset = frozenset()
+    static_resolved: bool = True
+    has_static: bool = False
+    has_donate: bool = False
+    decorator_of: Optional[str] = None  # def name when used as decorator
+    bare_decorator: bool = False        # @jax.jit (no kwargs possible)
+    in_loop: bool = False
+    enclosing: tuple = ()               # enclosing def names, outer first
+
+
+def _parse_jit_kwargs(site: JitSite, keywords: list) -> None:
+    for kw in keywords:
+        if kw.arg == "static_argnums":
+            site.has_static = True
+            vals, ok = _literal_ints(kw.value)
+            site.static_argnums |= vals
+            site.static_resolved = site.static_resolved and ok
+        elif kw.arg == "static_argnames":
+            site.has_static = True
+            vals, ok = _literal_strs(kw.value)
+            site.static_argnames |= vals
+            site.static_resolved = site.static_resolved and ok
+        elif kw.arg in ("donate_argnums", "donate_argnames"):
+            site.has_donate = True
+            if kw.arg == "donate_argnums":
+                vals, _ = _literal_ints(kw.value)
+                site.donate_argnums |= vals
+
+
+def _jit_decorator_site(dec: ast.AST, fn: ast.AST) -> Optional[JitSite]:
+    """A JitSite when ``dec`` applies jax.jit to ``fn``: bare
+    ``@jax.jit``, ``@partial(jax.jit, ...)``, or ``@jax.jit(...)``."""
+    name = fn.name
+    if _is_jit_expr(dec):
+        return JitSite(dec.lineno, target=name, fn_name=name,
+                       decorator_of=name, bare_decorator=True)
+    if isinstance(dec, ast.Call):
+        if _is_jit_expr(dec.func):
+            site = JitSite(dec.lineno, target=name, fn_name=name,
+                           decorator_of=name)
+            _parse_jit_kwargs(site, dec.keywords)
+            return site
+        _, cal = _callee(dec)
+        if cal == "partial" and dec.args and _is_jit_expr(dec.args[0]):
+            site = JitSite(dec.lineno, target=name, fn_name=name,
+                           decorator_of=name)
+            _parse_jit_kwargs(site, dec.keywords)
+            return site
+    return None
+
+
+@dataclass
+class ModuleModel:
+    """Everything the TPU5xx rules need to know about one module's jit
+    usage, collected in a single annotated walk."""
+
+    sf: SourceFile
+    jit_sites: list = field(default_factory=list)
+    jitted_defs: dict = field(default_factory=dict)  # def name -> JitSite
+    bindings: dict = field(default_factory=dict)     # bound name -> JitSite
+
+
+def _build_model(sf: SourceFile) -> ModuleModel:
+    model = ModuleModel(sf)
+    if sf.tree is None:
+        return model
+    parents: dict[int, ast.AST] = {}
+    context: dict[int, tuple[bool, tuple]] = {}  # id -> (in_loop, defs)
+
+    def annotate(node: ast.AST, in_loop: bool, stack: tuple) -> None:
+        context[id(node)] = (in_loop, stack)
+        child_loop = in_loop or isinstance(
+            node, (ast.For, ast.AsyncFor, ast.While))
+        child_stack = stack
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            child_stack = stack + (node.name,)
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+            annotate(child, child_loop, child_stack)
+
+    annotate(sf.tree, False, ())
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            in_loop, stack = context[id(node)]
+            for dec in node.decorator_list:
+                site = _jit_decorator_site(dec, node)
+                if site is not None:
+                    site.in_loop = in_loop
+                    site.enclosing = stack
+                    model.jit_sites.append(site)
+                    model.jitted_defs.setdefault(node.name, site)
+                    model.bindings.setdefault(node.name, site)
+        elif isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            in_loop, stack = context[id(node)]
+            site = JitSite(node.lineno, in_loop=in_loop, enclosing=stack)
+            if node.args:
+                wrapped = node.args[0]
+                if isinstance(wrapped, ast.Name):
+                    site.fn_name = wrapped.id
+                elif isinstance(wrapped, ast.Attribute):
+                    site.fn_name = wrapped.attr
+                elif isinstance(wrapped, ast.Call):
+                    _, site.factory_name = _callee(wrapped)
+            _parse_jit_kwargs(site, node.keywords)
+            parent = parents.get(id(node))
+            if isinstance(parent, ast.Assign) and parent.value is node:
+                for target in parent.targets:
+                    if isinstance(target, ast.Name):
+                        site.target = target.id
+                        model.bindings[target.id] = site
+            model.jit_sites.append(site)
+            if site.fn_name:
+                model.jitted_defs.setdefault(site.fn_name, site)
+    return model
+
+
+def _model(sf: SourceFile) -> ModuleModel:
+    cached = getattr(sf, "_jaxcheck_model", None)
+    if cached is None:
+        cached = sf._jaxcheck_model = _build_model(sf)
+    return cached
+
+
+# ----------------------------------------------------------------------
+# Body-walk helpers shared by TPU503/504/506
+# ----------------------------------------------------------------------
+
+
+def _own_body_nodes(fn_node: ast.AST) -> Iterable[ast.AST]:
+    """Every node in a def's own body, not descending into nested defs
+    (those are separate call-graph nodes)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _device_get_names(fn_node: ast.AST) -> set:
+    """Local names bound from jax.device_get(...) — the sanctioned
+    host-transfer spelling; conversions of these are explicit."""
+    names = set()
+    for node in _own_body_nodes(fn_node):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _is_device_get(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        names.update(
+                            e.id for e in t.elts if isinstance(e, ast.Name))
+    return names
+
+
+def _sanctioned(value: ast.AST, dg_names: set) -> bool:
+    """value is already an explicit host copy (device_get call or a
+    name bound from one) or a compile-time constant."""
+    if isinstance(value, ast.Constant):
+        return True
+    if isinstance(value, ast.Call) and _is_device_get(value):
+        return True
+    if isinstance(value, ast.Name) and value.id in dg_names:
+        return True
+    return False
+
+
+def _conversion_calls(fn_node: ast.AST, dg_names: set,
+                      param_names: Optional[set] = None):
+    """(call, kind) pairs for implicit host conversions in a def's own
+    body.  With ``param_names`` (jit-traced mode) only conversions
+    applied directly to a traced parameter count."""
+
+    def traced(value: ast.AST) -> bool:
+        if param_names is None:
+            return True
+        return isinstance(value, ast.Name) and value.id in param_names
+
+    for node in _own_body_nodes(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        root, name = _callee(node)
+        if isinstance(node.func, ast.Name) and name in _CONVERTERS:
+            if node.args and not _sanctioned(node.args[0], dg_names) \
+                    and traced(node.args[0]):
+                yield node, f"{name}()"
+        elif isinstance(node.func, ast.Attribute) and name in _ITEM_METHODS:
+            recv = node.func.value
+            if not _sanctioned(recv, dg_names) and traced(recv):
+                yield node, f".{name}()"
+        elif root in _NP_ROOTS and name in ("asarray", "array"):
+            if node.args and not _sanctioned(node.args[0], dg_names) \
+                    and traced(node.args[0]):
+                yield node, f"{root}.{name}()"
+
+
+# ----------------------------------------------------------------------
+# TPU501: static-looking jit parameters
+# ----------------------------------------------------------------------
+
+
+def _annotation_name(node: Optional[ast.AST]) -> Optional[str]:
+    if node is None:
+        return None
+    if isinstance(node, ast.Subscript):  # tuple[int, ...], Dict[str, int]
+        return _annotation_name(node.value)
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            return _annotation_name(ast.parse(node.value, mode="eval").body)
+        except SyntaxError:
+            return None
+    return None
+
+
+def _static_looking(arg: ast.arg, default: Optional[ast.AST]) -> Optional[str]:
+    ann = _annotation_name(arg.annotation)
+    if ann in _STATIC_LOOKING_ANNOTATIONS:
+        return f"annotation '{ann}'"
+    if isinstance(default, ast.Constant) and isinstance(
+            default.value, (bool, int, str)) and default.value is not None:
+        return f"default {default.value!r}"
+    if isinstance(default, (ast.Tuple, ast.Dict)):
+        return "tuple/dict literal default"
+    return None
+
+
+@rule("TPU501", "jit-nonstatic-scalar",
+      "A jax.jit-ed function signature carries a Python scalar/shape/"
+      "dict-shaped parameter (int/bool/str/tuple/dict annotation or "
+      "literal default) not listed in static_argnums/static_argnames — "
+      "a retrace-per-value recompile hazard.")
+def check_jit_static(repo: RepoView) -> Iterable[Finding]:
+    findings = []
+    for sf in repo.package_files():
+        if sf.tree is None:
+            continue
+        model = _model(sf)
+        graph = module_graph(sf)
+        for name, site in sorted(model.jitted_defs.items()):
+            if site.has_static and not site.static_resolved:
+                continue  # dynamic static set: cannot prove anything
+            candidates = graph.by_name.get(name, [])
+            if not candidates:
+                continue
+            fn = candidates[0].node
+            args = fn.args
+            positional = list(args.posonlyargs) + list(args.args)
+            defaults = list(args.defaults)
+            # defaults align with the TAIL of the positional list.
+            pad = [None] * (len(positional) - len(defaults))
+            pos_defaults = pad + defaults
+            offset = 0
+            if positional and positional[0].arg in ("self", "cls"):
+                positional = positional[1:]
+                pos_defaults = pos_defaults[1:]
+                offset = 1
+            for i, (arg, default) in enumerate(
+                    zip(positional, pos_defaults)):
+                if (i + offset) in site.static_argnums:
+                    continue
+                if arg.arg in site.static_argnames:
+                    continue
+                reason = _static_looking(arg, default)
+                if reason:
+                    findings.append(Finding(
+                        sf.rel, fn.lineno, "TPU501",
+                        f"jitted {name}() parameter '{arg.arg}' looks "
+                        f"static ({reason}) but is not in static_argnums"
+                        f"/static_argnames — every distinct value "
+                        "retraces (or concretizes a tracer)",
+                    ))
+            for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+                if arg.arg in site.static_argnames:
+                    continue
+                reason = _static_looking(arg, default)
+                if reason:
+                    findings.append(Finding(
+                        sf.rel, fn.lineno, "TPU501",
+                        f"jitted {name}() keyword parameter '{arg.arg}' "
+                        f"looks static ({reason}) but is not in "
+                        "static_argnames — every distinct value retraces "
+                        "(or concretizes a tracer)",
+                    ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# TPU502: jit reconstructed per iteration / per step
+# ----------------------------------------------------------------------
+
+
+@rule("TPU502", "jit-in-loop",
+      "jax.jit applied inside a loop body or per-step closure: each "
+      "evaluation wraps a fresh function object, so the jit cache "
+      "misses (recompiles) every iteration.")
+def check_jit_in_loop(repo: RepoView) -> Iterable[Finding]:
+    findings = []
+    for sf in repo.package_files():
+        if sf.tree is None:
+            continue
+        for site in _model(sf).jit_sites:
+            what = (f"@jit decoration of {site.decorator_of}()"
+                    if site.decorator_of else "jax.jit(...) call")
+            if site.in_loop:
+                findings.append(Finding(
+                    sf.rel, site.lineno, "TPU502",
+                    f"{what} inside a loop body — a fresh jitted "
+                    "callable (and a recompile) every iteration; hoist "
+                    "it out of the loop",
+                ))
+            elif any(STEP_NAME_RE.fullmatch(n) for n in site.enclosing):
+                outer = next(n for n in site.enclosing
+                             if STEP_NAME_RE.fullmatch(n))
+                findings.append(Finding(
+                    sf.rel, site.lineno, "TPU502",
+                    f"{what} inside per-step function {outer}() — "
+                    "re-jitted on every step; build the jitted callable "
+                    "once outside the step",
+                ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# TPU503: implicit host transfers on the step path
+# ----------------------------------------------------------------------
+
+
+@rule("TPU503", "host-transfer-in-step",
+      "float()/int()/.item()/.tolist()/np.asarray()/print() on device "
+      "values inside a step function or an un-jitted helper reachable "
+      "from one — an implicit device-to-host sync on the hot path.  "
+      "Explicit jax.device_get(...) at a step boundary is exempt.")
+def check_step_host_transfers(repo: RepoView) -> Iterable[Finding]:
+    findings = []
+    for sf in repo.package_files():
+        if sf.tree is None:
+            continue
+        model = _model(sf)
+        graph = module_graph(sf)
+        jitted = set(model.jitted_defs)
+        roots = [fn for fn in graph.functions
+                 if STEP_NAME_RE.fullmatch(fn.name)]
+        if not roots:
+            continue
+        scope = graph.reachable(
+            roots, stop=lambda fn: fn.name in jitted)
+        for fn in scope:
+            dg_names = _device_get_names(fn.node)
+            params = None
+            if fn.name in jitted:
+                args = fn.node.args
+                params = {
+                    a.arg for a in (
+                        list(args.posonlyargs) + list(args.args)
+                        + list(args.kwonlyargs)
+                    )
+                }
+                site = model.jitted_defs[fn.name]
+                params -= set(site.static_argnames)
+            for call, kind in _conversion_calls(fn.node, dg_names, params):
+                findings.append(Finding(
+                    sf.rel, call.lineno, "TPU503",
+                    f"implicit host transfer on the step path: {kind} "
+                    f"on a device value in {fn.name}() — wrap in "
+                    "jax.device_get at a step boundary or move off the "
+                    "hot path",
+                ))
+            for node in _own_body_nodes(fn.node):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "print"):
+                    if params is not None and not any(
+                            isinstance(a, ast.Name) and a.id in params
+                            for a in node.args):
+                        continue
+                    findings.append(Finding(
+                        sf.rel, node.lineno, "TPU503",
+                        f"print() in step-path function {fn.name}() "
+                        "synchronizes the device per call — use "
+                        "jax.debug.print or log outside the step",
+                    ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# TPU504: donated buffers read after the call
+# ----------------------------------------------------------------------
+
+
+def _assign_target_names(stmt: ast.AST) -> set:
+    names = set()
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Name):
+                names.add(node.id)
+    return names
+
+
+@rule("TPU504", "donated-arg-reuse",
+      "A buffer donated through donate_argnums is read again after the "
+      "call (donation invalidates it), or re-donated every loop "
+      "iteration without being rebound from the call's result.")
+def check_donated_reuse(repo: RepoView) -> Iterable[Finding]:
+    findings = []
+    for sf in repo.package_files():
+        if sf.tree is None:
+            continue
+        model = _model(sf)
+        donated = {
+            name: site for name, site in model.bindings.items()
+            if site.donate_argnums
+        }
+        if not donated:
+            continue
+        graph = module_graph(sf)
+        for fn in graph.functions:
+            body = list(_own_body_nodes(fn.node))
+            loads = [
+                n for n in body
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+            ]
+            # statement context: which assignment owns each call, and
+            # whether the call sits in a loop.
+            for stmt in body:
+                if not isinstance(stmt, (
+                        ast.Assign, ast.AugAssign, ast.Expr, ast.Return)):
+                    continue
+                value = getattr(stmt, "value", None)
+                if value is None:
+                    continue
+                for call in ast.walk(value):
+                    if not (isinstance(call, ast.Call)
+                            and isinstance(call.func, ast.Name)
+                            and call.func.id in donated):
+                        continue
+                    site = donated[call.func.id]
+                    rebinds = _assign_target_names(stmt)
+                    in_loop = _stmt_in_loop(fn.node, stmt)
+                    for idx in sorted(site.donate_argnums):
+                        if idx >= len(call.args):
+                            continue
+                        arg = call.args[idx]
+                        if not isinstance(arg, ast.Name):
+                            continue
+                        if arg.id in rebinds:
+                            continue  # state = step(state): legal
+                        later = [n for n in loads
+                                 if n.id == arg.id
+                                 and n.lineno > call.lineno]
+                        if later:
+                            use = min(later, key=lambda n: n.lineno)
+                            findings.append(Finding(
+                                sf.rel, use.lineno, "TPU504",
+                                f"'{arg.id}' was donated to "
+                                f"{call.func.id}() at line {call.lineno} "
+                                "and is read again here — donated "
+                                "buffers are invalidated by the call",
+                            ))
+                        elif in_loop:
+                            findings.append(Finding(
+                                sf.rel, call.lineno, "TPU504",
+                                f"'{arg.id}' is donated to "
+                                f"{call.func.id}() every loop iteration "
+                                "but never rebound from its result — "
+                                "the second iteration donates a dead "
+                                "buffer",
+                            ))
+    return findings
+
+
+def _stmt_in_loop(fn_node: ast.AST, stmt: ast.AST) -> bool:
+    """True when stmt is lexically inside a for/while in fn's own body."""
+    def search(node: ast.AST, in_loop: bool) -> Optional[bool]:
+        for child in ast.iter_child_nodes(node):
+            if child is stmt:
+                return in_loop or isinstance(
+                    node, (ast.For, ast.AsyncFor, ast.While))
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            found = search(child, in_loop or isinstance(
+                child, (ast.For, ast.AsyncFor, ast.While)))
+            if found is not None:
+                return found
+        return None
+    return bool(search(fn_node, False))
+
+
+# ----------------------------------------------------------------------
+# TPU505: train steps without donation
+# ----------------------------------------------------------------------
+
+
+@rule("TPU505", "step-without-donation",
+      "A train/update step is jitted without donate_argnums/"
+      "donate_argnames: params and optimizer state live twice in HBM "
+      "across every step (old operand + new result).")
+def check_step_donation(repo: RepoView) -> Iterable[Finding]:
+    findings = []
+    for sf in repo.package_files():
+        if sf.tree is None:
+            continue
+        for site in _model(sf).jit_sites:
+            if site.has_donate:
+                continue
+            step_name = None
+            if site.fn_name and TRAIN_STEP_RE.fullmatch(site.fn_name):
+                step_name = site.fn_name
+            elif site.factory_name and STEP_FACTORY_RE.fullmatch(
+                    site.factory_name):
+                step_name = f"{site.factory_name}(...)"
+            if step_name is None:
+                continue
+            hint = (
+                "use jax.jit(fn, donate_argnums=...) instead of the bare "
+                "decorator" if site.bare_decorator
+                else "add donate_argnums for params/opt state"
+            )
+            findings.append(Finding(
+                sf.rel, site.lineno, "TPU505",
+                f"train step {step_name} jitted without buffer "
+                f"donation — params+opt state held twice in HBM; {hint}",
+            ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# TPU506: host syncs inside hot loops
+# ----------------------------------------------------------------------
+
+
+def _loop_is_hot(loop: ast.AST, hot_names: set) -> bool:
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call):
+            _, name = _callee(node)
+            if name in hot_names or STEP_NAME_RE.fullmatch(name or ""):
+                return True
+    return False
+
+
+@rule("TPU506", "hot-loop-host-sync",
+      "A loop drives a jitted callable and converts device values "
+      "(float()/int()/.item()/np.asarray()) in the same body — one "
+      "device round-trip per iteration.  Accumulate on device, or "
+      "jax.device_get explicitly at the boundary.")
+def check_hot_loop_sync(repo: RepoView) -> Iterable[Finding]:
+    findings = []
+    for sf in repo.package_files():
+        if sf.tree is None:
+            continue
+        model = _model(sf)
+        hot_names = set(model.bindings) | set(model.jitted_defs)
+        graph = module_graph(sf)
+        for fn in graph.functions:
+            dg_names = _device_get_names(fn.node)
+            for node in _own_body_nodes(fn.node):
+                if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                    continue
+                if not _loop_is_hot(node, hot_names):
+                    continue
+                for call, kind in _conversion_calls(node, dg_names):
+                    findings.append(Finding(
+                        sf.rel, call.lineno, "TPU506",
+                        f"implicit host sync in a hot loop: {kind} "
+                        "while the loop drives a jitted step — one "
+                        "device round-trip per iteration",
+                    ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# TPU507: pallas tile hygiene
+# ----------------------------------------------------------------------
+
+
+@rule("TPU507", "pallas-tile-literal",
+      "An ops/ kernel takes its grid/tile size from a private numeric "
+      "literal instead of the shared tile-selection plumbing in "
+      "ops/_common.py — invisible to the kernel autotuner.")
+def check_tile_hygiene(repo: RepoView) -> Iterable[Finding]:
+    findings = []
+    for sf in repo.package_files():
+        if sf.tree is None:
+            continue
+        if not sf.rel.startswith("mpi_operator_tpu/ops/"):
+            continue
+        if sf.rel.endswith("_common.py"):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                positional = list(args.posonlyargs) + list(args.args)
+                defaults = list(args.defaults)
+                pad = [None] * (len(positional) - len(defaults))
+                pairs = list(zip(positional, pad + defaults)) + list(
+                    zip(args.kwonlyargs, args.kw_defaults))
+                for arg, default in pairs:
+                    if not TILE_PARAM_RE.fullmatch(arg.arg):
+                        continue
+                    if isinstance(default, ast.Constant) and isinstance(
+                            default.value, (int, float)):
+                        findings.append(Finding(
+                            sf.rel, node.lineno, "TPU507",
+                            f"kernel {node.name}() defaults tile "
+                            f"parameter '{arg.arg}' to the literal "
+                            f"{default.value} — take it from "
+                            "ops/_common.py so the autotuner can "
+                            "override it",
+                        ))
+        if sf.tree is not None:
+            for stmt in sf.tree.body:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for target in stmt.targets:
+                    if (isinstance(target, ast.Name)
+                            and TILE_CONST_RE.fullmatch(target.id)
+                            and isinstance(stmt.value, ast.Constant)
+                            and isinstance(stmt.value.value, (int, float))):
+                        findings.append(Finding(
+                            sf.rel, stmt.lineno, "TPU507",
+                            f"module-level tile constant {target.id} "
+                            "defined outside ops/_common.py — move it "
+                            "into the shared tile plumbing",
+                        ))
+    return findings

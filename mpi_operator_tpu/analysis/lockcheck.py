@@ -34,7 +34,14 @@ import ast
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
-from .framework import Finding, RepoView, SourceFile, rule
+from .framework import (
+    Finding,
+    RepoView,
+    SourceFile,
+    intersect_fixpoint,
+    rule,
+    union_fixpoint,
+)
 
 # Calls that create a lock object when assigned to a self attribute.
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}
@@ -400,7 +407,8 @@ def entry_held_sets(cls: ClassInfo) -> dict[str, frozenset]:
     as a value (thread targets, callbacks) can be entered with nothing
     held.  A private method only ever called while a lock is held
     inherits that guard: start every candidate at the full lock set and
-    intersect over call sites until the fixpoint.
+    intersect over call sites until the fixpoint (the shared
+    :func:`framework.intersect_fixpoint`).
     """
     locks = cls.lock_ids()
     entry: dict[str, frozenset] = {}
@@ -418,19 +426,7 @@ def entry_held_sets(cls: ClassInfo) -> dict[str, frozenset]:
             or not called_from[name]
         )
         entry[name] = frozenset() if externally_enterable else locks
-    changed = True
-    while changed:
-        changed = False
-        for name, sites in called_from.items():
-            if not entry[name]:
-                continue
-            acc = entry[name]
-            for caller, held_at_site in sites:
-                acc = acc & (entry[caller] | held_at_site)
-            if acc != entry[name]:
-                entry[name] = acc
-                changed = True
-    return entry
+    return intersect_fixpoint(entry, called_from)
 
 
 # ----------------------------------------------------------------------
@@ -483,26 +479,19 @@ def guard_findings(classes: dict[str, ClassInfo]) -> list[Finding]:
 
 def _transitive_acquires(classes: dict[str, ClassInfo]) -> dict:
     """(class, method) -> frozenset of lock ids the call may acquire,
-    including through intra- and cross-class calls (fixpoint)."""
-    acq: dict[tuple[str, str], frozenset] = {}
+    including through intra- and cross-class calls (the shared
+    :func:`framework.union_fixpoint`)."""
+    seed: dict[tuple[str, str], frozenset] = {}
+    edges: dict[tuple[str, str], list] = {}
     for cls in classes.values():
         for m in cls.methods.values():
-            acq[(cls.name, m.name)] = frozenset(
-                a.lock for a in m.acquires)
-    changed = True
-    while changed:
-        changed = False
-        for cls in classes.values():
-            for m in cls.methods.values():
-                key = (cls.name, m.name)
-                acc = acq[key]
-                for call in m.calls:
-                    target = (call.callee_class or cls.name, call.callee)
-                    acc = acc | acq.get(target, frozenset())
-                if acc != acq[key]:
-                    acq[key] = acc
-                    changed = True
-    return acq
+            key = (cls.name, m.name)
+            seed[key] = frozenset(a.lock for a in m.acquires)
+            edges[key] = [
+                (call.callee_class or cls.name, call.callee)
+                for call in m.calls
+            ]
+    return union_fixpoint(seed, edges)
 
 
 def lock_order_edges(classes: dict[str, ClassInfo]) -> dict:

@@ -162,14 +162,18 @@ def main(argv=None) -> int:
             (args.batch, seq_len), sharding, cb
         )
 
+    # Accumulate on device: float() per batch would force one host
+    # round-trip per iteration (TPU506); a single explicit device_get
+    # after the loop is the sanctioned sync point.
     total = np.float64(0.0)
     count = np.float64(0.0)
     with ctx:
         for b in range(n_batches):
             loss_sum, n = stats(params, to_device(b))
-            total += float(loss_sum)
-            count += float(n)
+            total = total + loss_sum
+            count = count + n
     ds.close()
+    total, count = (np.float64(v) for v in jax.device_get((total, count)))
 
     mean = total / max(count, 1.0)
     if jax.process_index() == 0:  # one JSON line per JOB, not per host

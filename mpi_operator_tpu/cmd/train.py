@@ -31,7 +31,7 @@ import sys
 import time
 from typing import Callable, Optional
 
-from ..utils import trace
+from ..utils import jaxtrace, trace
 from ..utils.logging import get_logger
 
 log = get_logger("train")
@@ -936,6 +936,7 @@ def main(argv=None) -> int:
         while step < end:
             if step == timed_from:
                 jax.block_until_ready(work.state)
+                jaxtrace.note_warmup_complete()
                 t0 = t_log = time.perf_counter()
                 last_log_step = step
             if args.profile_dir and step == timed_from + 10:
@@ -944,6 +945,7 @@ def main(argv=None) -> int:
             batch = next(batches)[1] if batches is not None else work.batch
             work.state, loss = work.step_fn(work.state, batch)
             step += 1
+            jaxtrace.note_step()
             now = time.perf_counter()
             telem.record_step(step, now - t_prev, warmup=step <= timed_from)
             t_prev = now
@@ -953,15 +955,18 @@ def main(argv=None) -> int:
                 tracing = False
                 log.info("profiler trace written to %s", args.profile_dir)
             if args.log_every and step % args.log_every == 0:
-                jax.block_until_ready(loss)
+                # The log cadence is the explicit sync point: device_get
+                # blocks until the step lands, so the ms/step below
+                # measures completed work (and the float() is sanctioned).
+                loss_val = float(jax.device_get(loss))
                 if t_log is not None and step > last_log_step:
                     now = time.perf_counter()
                     ms = (now - t_log) / (step - last_log_step) * 1000
                     log.info("step %d: loss=%.4f %.1f ms/step",
-                             step, float(loss), ms)
+                             step, loss_val, ms)
                     t_log, last_log_step = now, step
                 else:  # still inside warmup: loss only, no bogus timing
-                    log.info("step %d: loss=%.4f (warmup)", step, float(loss))
+                    log.info("step %d: loss=%.4f (warmup)", step, loss_val)
             if ckpt is not None:
                 t_ckpt = time.perf_counter()
                 ckpt.save(step, work.state)
@@ -982,7 +987,7 @@ def main(argv=None) -> int:
         # Preemption can land before the timed window opened.
         timed_steps = max(step - timed_from, 0)
         elapsed = (time.perf_counter() - t0) if t0 is not None else 0.0
-        final_loss = float(loss)
+        final_loss = float(jax.device_get(loss))
 
     if ckpt is not None:
         t_ckpt = time.perf_counter()
@@ -1020,6 +1025,8 @@ def main(argv=None) -> int:
         summary["tokens_per_sec"] = round(
             work.tokens_per_step * timed_steps / elapsed, 1
         )
+    if jaxtrace.enabled():
+        summary["jax_trace"] = jaxtrace.tracer().report()
     print(json.dumps(summary))
     return 0
 

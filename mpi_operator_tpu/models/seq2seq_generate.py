@@ -151,7 +151,7 @@ def _decode_step(params, cfg: Seq2SeqConfig, self_caches, cross_kvs,
     return f32_logits(x, embed.T), new_caches
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new"))
+@partial(jax.jit, static_argnames=("cfg", "max_new", "bos_id"))
 def generate(params, src_tokens, cfg: Seq2SeqConfig, max_new: int,
              bos_id: int = 0):
     """Greedy decode ``max_new`` tokens conditioned on ``src_tokens``

@@ -4,6 +4,30 @@ from __future__ import annotations
 
 import jax
 
+# ----------------------------------------------------------------------
+# Tile-selection plumbing.
+#
+# Every kernel's grid/tile defaults are named here — ONE place — so the
+# roadmap's admission-time autotuner can override them per geometry
+# without chasing magic numbers through kernel signatures, and so the
+# TPU507 analyzer rule can statically prove no kernel grew a private
+# tile constant.  The values are the measured v5e winners (TUNE_CAPTURE
+# r5: fb256 is the *model-level* flash default; 128 stays the kernel-
+# level floor that every geometry, including ring/ulysses shards,
+# satisfies).
+# ----------------------------------------------------------------------
+
+DEFAULT_BLOCK_Q = 128   # flash attention q-tile (rows per grid step)
+DEFAULT_BLOCK_K = 128   # flash attention k-tile (columns per inner step)
+DEFAULT_TILE_M = 512    # BN stats/grads row tile (8-row granule multiple)
+
+
+def clamp_tile(tile: int, extent: int, floor: int = 1) -> int:
+    """The shared tile clamp: a tile never exceeds the axis extent it
+    walks (short sequences, small row counts) but keeps a floor so a
+    degenerate extent still yields a legal grid."""
+    return min(tile, max(extent, floor))
+
 
 def use_interpret() -> bool:
     """Pallas interpret mode off-TPU: the same kernels execute (slowly)

@@ -28,7 +28,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._common import use_interpret as _use_interpret
+from ._common import (
+    DEFAULT_BLOCK_K,
+    DEFAULT_BLOCK_Q,
+    clamp_tile,
+    use_interpret as _use_interpret,
+)
 
 NEG_INF = -1e30  # safe "minus infinity": avoids inf-inf → nan in masking
 
@@ -1335,8 +1340,8 @@ def flash_attention_bshd_lse(
     col_ids=None,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
 ):
     """Projection-layout flash attention returning ``(out, lse)`` —
@@ -1371,8 +1376,8 @@ def flash_attention_bshd_lse(
         sm_scale = d ** -0.5
     if interpret is None:
         interpret = _use_interpret()
-    block_q = min(block_q, max(q_len, 1))
-    block_k = min(block_k, max(kv_len, 1))
+    block_q = clamp_tile(block_q, q_len)
+    block_k = clamp_tile(block_k, kv_len)
     out, lse = _flash_flat_lse(
         q.reshape(b, q_len, h * d),
         k.reshape(b, kv_len, h_kv * d),
@@ -1387,8 +1392,8 @@ def flash_attention_bshd(
     *,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
 ):
     """Flash attention over the PROJECTION layout: q [B, Sq, H, D];
@@ -1417,8 +1422,8 @@ def flash_attention_bshd(
         sm_scale = d ** -0.5
     if interpret is None:
         interpret = _use_interpret()
-    block_q = min(block_q, max(q_len, 1))
-    block_k = min(block_k, max(kv_len, 1))
+    block_q = clamp_tile(block_q, q_len)
+    block_k = clamp_tile(block_k, kv_len)
     out = _flash_flat(
         q.reshape(b, q_len, h * d),        # free: H, D are contiguous
         k.reshape(b, kv_len, h_kv * d),
@@ -1433,8 +1438,8 @@ def flash_attention(
     *,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
 ):
     """Flash attention. q [B, H, Sq, D]; k, v [B, Hkv, Sk, D] → [B, H, Sq, D].
@@ -1457,8 +1462,8 @@ def flash_attention(
     h_kv, kv_len = k.shape[1], k.shape[2]
     if h % h_kv:
         raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
-    block_q = min(block_q, max(q_len, 1))
-    block_k = min(block_k, max(kv_len, 1))
+    block_q = clamp_tile(block_q, q_len)
+    block_k = clamp_tile(block_k, kv_len)
     flat = lambda x: x.reshape(b * x.shape[1], x.shape[2], d)
     out = _flash(
         flat(q), flat(k), flat(v), sm_scale, causal, block_q, block_k, interpret
@@ -1473,8 +1478,8 @@ def flash_attention_lse(
     col_ids=None,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
 ):
     """Flash attention returning ``(out, lse)`` — the building block for
@@ -1513,8 +1518,8 @@ def flash_attention_lse(
     h_kv, kv_len = k.shape[1], k.shape[2]
     if h % h_kv:
         raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
-    block_q = min(block_q, max(q_len, 1))
-    block_k = min(block_k, max(kv_len, 1))
+    block_q = clamp_tile(block_q, q_len)
+    block_k = clamp_tile(block_k, kv_len)
     flat = lambda x: x.reshape(b * x.shape[1], x.shape[2], d)
     out, lse = _flash_lse(
         flat(q), flat(k), flat(v), row_ids, col_ids,

@@ -32,9 +32,11 @@ import numpy as np
 from flax import linen as nn
 from jax.experimental import pallas as pl
 
-from ._common import use_interpret as _use_interpret
-
-DEFAULT_TILE_M = 512
+from ._common import (
+    DEFAULT_TILE_M,
+    clamp_tile,
+    use_interpret as _use_interpret,
+)
 
 
 def _row_mask(shape, base, m):
@@ -72,7 +74,7 @@ def bn_stats(x2d, *, tile_m: int = DEFAULT_TILE_M):
     """Per-channel (sum, sum-of-squares) of an [M, C] array in ONE pass,
     f32 accumulation regardless of input dtype. Returns two f32 [C]."""
     m, c = x2d.shape
-    tile_m = min(tile_m, max(8, m))
+    tile_m = clamp_tile(tile_m, m, floor=8)
     grid = (m + tile_m - 1) // tile_m
     s, q = pl.pallas_call(
         functools.partial(_stats_kernel, m=m, tile_m=tile_m),
@@ -117,7 +119,7 @@ def bn_grads(dy2d, x2d, mean, inv_std, *, tile_m: int = DEFAULT_TILE_M):
     """Per-channel (dβ, dγ) = (Σdy, Σ dy·x̂) from ONE fused pass over
     (dy, x). Returns two f32 [C]."""
     m, c = dy2d.shape
-    tile_m = min(tile_m, max(8, m))
+    tile_m = clamp_tile(tile_m, m, floor=8)
     grid = (m + tile_m - 1) // tile_m
     db, dg = pl.pallas_call(
         functools.partial(_grads_kernel, m=m, tile_m=tile_m),

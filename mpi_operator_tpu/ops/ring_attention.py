@@ -44,6 +44,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import DP, FSDP, SP, TP
+from ._common import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
 from .attention import flash_attention_lse
 
 NEG_INF = -1e30
@@ -196,7 +197,7 @@ def _dense_partial(q, k, v, row, col, causal, sm_scale):
 
 
 def _flash_partial(q, k, v, row, col, causal, sm_scale,
-                   block_q=128, block_k=128):
+                   block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     if causal:
         out, lse = flash_attention_lse(
             q, k, v, row_ids=row, col_ids=col, sm_scale=sm_scale,
@@ -222,8 +223,8 @@ def ring_attention(
     sm_scale: Optional[float] = None,
     zigzag: bool = False,
     impl: str = "flash",
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
 ):
     """Per-shard ring attention — call inside shard_map/pmap.
 
@@ -330,8 +331,8 @@ def ring_attention_bshd(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     zigzag: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
     tp_manual: bool = False,
 ):
     """Per-shard ring attention over the PROJECTION layout — the
@@ -410,8 +411,8 @@ def ring_attention_bshd_shard_mapped(
     sm_scale: Optional[float] = None,
     axis: str = SP,
     zigzag: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
 ):
     """shard_map of the projection-layout ring — what the models'
     ``attention_impl='ring'`` now calls directly on the raw
@@ -439,8 +440,8 @@ def sp_attention_bshd(
     *,
     causal: bool,
     zigzag: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
 ):
     """Projection-layout twin of :func:`sp_attention` — the single
     dispatch bert/llama call on the RAW [B, S, H, D] projections before
@@ -516,8 +517,8 @@ def sp_attention(
     *,
     causal: bool,
     zigzag: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
 ):
     """The single attention dispatch for model code (llama, bert):
     'flash'/'flash-bhsd' (pallas kernel over this [B, H, S, D]
@@ -601,8 +602,8 @@ def ring_attention_shard_mapped(
     axis: str = SP,
     zigzag: bool = False,
     impl: str = "flash",
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
 ):
     """shard_map the per-shard ring kernel over the mesh — composable
     inside a larger jitted computation (models call this directly).

@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel.mesh import SP
+from ._common import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
 from .attention import (
     attention_reference,
     flash_attention,
@@ -65,8 +66,8 @@ def ulysses_attention(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     impl: str = "flash",
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
 ):
     """Per-shard Ulysses attention — call inside shard_map/pmap.
 
@@ -135,8 +136,8 @@ def ulysses_attention_shard_mapped(
     sm_scale: Optional[float] = None,
     axis: str = SP,
     impl: str = "flash",
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
 ):
     """shard_map the per-shard Ulysses kernel over the mesh — composable
     inside a larger jitted computation (models call this directly).
@@ -172,8 +173,8 @@ def ulysses_attention_bshd(
     *,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
     tp_manual: bool = False,
 ):
     """Per-shard Ulysses attention over the PROJECTION layout — the
@@ -238,8 +239,8 @@ def ulysses_attention_bshd_shard_mapped(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     axis: str = SP,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
 ):
     """shard_map of the projection-layout Ulysses kernel — what the
     models' ``attention_impl='ulysses'`` now calls directly on the raw

@@ -1,0 +1,289 @@
+"""Runtime jit-recompile / host-transfer tracer (locktrace's analog for
+the training stack).
+
+The TPU5xx static rules (``analysis/jaxcheck.py``) prove at the AST
+level that the step path cannot recompile or sync; this module proves
+it at *runtime*.  When armed it hooks the two chokepoints the bug
+classes share:
+
+- **compiles** — ``jax.monitoring``'s backend-compile duration event
+  fires once per XLA compilation (i.e. per jit cache miss).  Any
+  compile after :func:`note_warmup_complete` is a
+  *recompile-after-warmup*: a shape/dtype/static-arg leak that the
+  warmup steps did not cover, costing a full compile mid-training.
+
+- **device-to-host transfers** — every implicit materialization
+  (``float(arr)``, ``np.asarray(arr)``, ``.item()``, ``print(arr)``)
+  funnels through the array's ``_value`` property; the patch counts
+  bytes and attributes them to the first non-jax caller frame.  Only
+  reads that actually move bytes count: a second ``float()`` of the
+  same array hits the numpy cache, and on the CPU backend
+  ``np.asarray`` is zero-copy shared memory — neither is a transfer.
+
+Zero cost when off: hooks are installed once, on first
+:func:`enable`, and check one module global before doing any work —
+un-armed processes never even install them.  Arm with the
+``TPU_JAX_TRACE=1`` environment variable (picked up by ``cmd/train.py``
+and ``bench.py``), the bench harness's ``--jax-trace`` flag, or
+``jaxtrace.enable()`` in tests.
+
+The report rides in bench/train result blocks as ``"jax_trace"`` the
+same way locktrace's rides as ``"lock_trace"``::
+
+    {"compiles": {"total": 3, "seconds": 1.82, "after_warmup": 0,
+                  "sites": []},
+     "transfers": {"count": 2, "bytes": 8, "after_warmup_count": 0,
+                   "after_warmup_bytes": 0, "top_sites": {...}},
+     "steps_after_warmup": 64,
+     "transfer_bytes_per_step": 0.0}
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from collections import Counter
+from typing import Optional
+
+ENV_FLAG = "TPU_JAX_TRACE"
+
+# Frames of caller stack kept per compile-after-warmup sample.
+_STACK_DEPTH = 8
+# Distinct transfer sites kept in the report.
+_TOP_SITES = 8
+
+_COMPILE_EVENT_SUFFIX = "backend_compile_duration"
+
+_SELF_FILE = os.path.abspath(__file__)
+
+
+class RecompileError(AssertionError):
+    """Raised by ``JaxTracer.assert_no_recompiles_after_warmup`` with
+    the offending compile sites in the message."""
+
+
+def _caller_site() -> str:
+    """file:line of the nearest frame outside jax and this module —
+    the user code that forced the transfer/compile."""
+    for frame in reversed(traceback.extract_stack()):
+        fn = frame.filename.replace(os.sep, "/")
+        if ("/jax/" in fn or "/jaxlib/" in fn
+                or os.path.abspath(frame.filename) == _SELF_FILE):
+            continue
+        return f"{frame.filename}:{frame.lineno}"
+    return "<unknown>"
+
+
+def _caller_stack() -> list[str]:
+    frames = [
+        f"{f.filename}:{f.lineno}:{f.name}"
+        for f in traceback.extract_stack()
+        if "/jax/" not in f.filename.replace(os.sep, "/")
+        and "/jaxlib/" not in f.filename.replace(os.sep, "/")
+        and os.path.abspath(f.filename) != _SELF_FILE
+    ]
+    return frames[-_STACK_DEPTH:]
+
+
+class JaxTracer:
+    """Counts compiles and device-to-host transfers, split at the
+    warmup boundary.  The monitoring listener can fire from compile
+    threads, so all state is lock-guarded (the lock is internal —
+    never visible to locktrace)."""
+
+    def __init__(self, capture_stacks: bool = True):
+        self.capture_stacks = capture_stacks
+        self._mu = threading.Lock()
+        self._warmup_done = False
+        self._steps_after_warmup = 0
+        self._compiles = 0
+        self._compile_seconds = 0.0
+        self._compiles_after_warmup = 0
+        self._compile_sites: list[dict] = []
+        self._transfers = 0
+        self._transfer_bytes = 0
+        self._transfers_after_warmup = 0
+        self._transfer_bytes_after_warmup = 0
+        self._transfer_sites: Counter = Counter()
+
+    # -- hook callbacks --------------------------------------------------
+
+    def on_compile(self, duration_secs: float) -> None:
+        with self._mu:
+            self._compiles += 1
+            self._compile_seconds += duration_secs
+            if self._warmup_done:
+                self._compiles_after_warmup += 1
+                site = {
+                    "seconds": round(duration_secs, 6),
+                    "stack": _caller_stack() if self.capture_stacks else [],
+                }
+                self._compile_sites.append(site)
+
+    def on_transfer(self, nbytes: int) -> None:
+        site = _caller_site() if self.capture_stacks else "<off>"
+        with self._mu:
+            self._transfers += 1
+            self._transfer_bytes += nbytes
+            self._transfer_sites[site] += 1
+            if self._warmup_done:
+                self._transfers_after_warmup += 1
+                self._transfer_bytes_after_warmup += nbytes
+
+    # -- step-loop annotations ------------------------------------------
+
+    def note_warmup_complete(self) -> None:
+        """The step loop finished warmup (and synced): compiles and
+        transfers from here on are hot-path regressions."""
+        with self._mu:
+            self._warmup_done = True
+
+    def note_step(self) -> None:
+        with self._mu:
+            if self._warmup_done:
+                self._steps_after_warmup += 1
+
+    # -- reporting -------------------------------------------------------
+
+    def report(self) -> dict:
+        """JSON-friendly summary, attached to bench/train result blocks
+        as ``"jax_trace"``."""
+        with self._mu:
+            steps = self._steps_after_warmup
+            per_step = (
+                self._transfer_bytes_after_warmup / steps if steps else 0.0
+            )
+            return {
+                "compiles": {
+                    "total": self._compiles,
+                    "seconds": round(self._compile_seconds, 6),
+                    "after_warmup": self._compiles_after_warmup,
+                    "sites": [dict(s) for s in self._compile_sites],
+                },
+                "transfers": {
+                    "count": self._transfers,
+                    "bytes": self._transfer_bytes,
+                    "after_warmup_count": self._transfers_after_warmup,
+                    "after_warmup_bytes": self._transfer_bytes_after_warmup,
+                    "top_sites": dict(
+                        self._transfer_sites.most_common(_TOP_SITES)
+                    ),
+                },
+                "steps_after_warmup": steps,
+                "transfer_bytes_per_step": round(per_step, 3),
+            }
+
+    def assert_no_recompiles_after_warmup(self) -> None:
+        with self._mu:
+            count = self._compiles_after_warmup
+            sites = list(self._compile_sites)
+        if count:
+            lines = [f"{count} recompile(s) after warmup:"]
+            for site in sites:
+                lines.append(f"  compile took {site['seconds']}s")
+                for frame in site["stack"][-4:]:
+                    lines.append(f"    {frame}")
+            raise RecompileError("\n".join(lines))
+
+
+# ----------------------------------------------------------------------
+# Process-global switch + hook installation
+# ----------------------------------------------------------------------
+
+_tracer: Optional[JaxTracer] = None
+_hooks_installed = False
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def tracer() -> Optional[JaxTracer]:
+    """The active tracer, or None when tracing is off."""
+    return _tracer
+
+
+def enable(active: Optional[JaxTracer] = None) -> JaxTracer:
+    """Arm tracing; returns the tracer.  Installs the process-wide
+    hooks on first use — call before the steps under test (compiles
+    that already happened are not back-counted)."""
+    global _tracer
+    _tracer = active if active is not None else JaxTracer()
+    _install_hooks()
+    return _tracer
+
+
+def disable() -> None:
+    global _tracer
+    _tracer = None
+
+
+def note_warmup_complete() -> None:
+    """Module-level convenience: no-op when tracing is off."""
+    t = _tracer
+    if t is not None:
+        t.note_warmup_complete()
+
+
+def note_step() -> None:
+    t = _tracer
+    if t is not None:
+        t.note_step()
+
+
+def _on_compile_event(event: str, duration_secs: float, **kw) -> None:
+    t = _tracer
+    if t is not None and event.endswith(_COMPILE_EVENT_SUFFIX):
+        t.on_compile(duration_secs)
+
+
+def _install_hooks() -> None:
+    """Register the compile listener and patch the device-to-host
+    chokepoint.  Idempotent; both hooks gate on the module global, so a
+    disabled tracer costs one attribute read per event."""
+    global _hooks_installed
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+
+    try:
+        import jax
+
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_compile_event
+        )
+    except Exception:  # pragma: no cover - jax too old / absent
+        pass
+
+    try:
+        from jax._src.array import ArrayImpl
+
+        orig = ArrayImpl._value
+        orig_fget = orig.fget
+
+        def _traced_value(self):
+            t = _tracer
+            # _npy_value None means this read actually moves bytes;
+            # a cached re-read is free and must not count.
+            if t is not None and getattr(self, "_npy_value", 1) is None:
+                try:
+                    nbytes = int(self.nbytes)
+                except Exception:  # pragma: no cover - exotic dtypes
+                    nbytes = 0
+                t.on_transfer(nbytes)
+            return orig_fget(self)
+
+        ArrayImpl._value = property(_traced_value)
+    except Exception:  # pragma: no cover - jax internals moved
+        pass
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "").strip().lower() not in (
+        "", "0", "false", "off", "no",
+    )
+
+
+if _env_enabled():  # pragma: no cover - exercised via subprocess tests
+    enable()

@@ -98,8 +98,13 @@ class TestFramework:
         ids = [r.id for r in framework.all_rules()]
         assert ids == sorted(ids) and len(ids) == len(set(ids))
         for required in ("TPU001", "TPU110", "TPU111", "TPU301", "TPU302",
-                         "TPU303", "TPU401", "TPU402"):
+                         "TPU303", "TPU401", "TPU402", "TPU501", "TPU502",
+                         "TPU503", "TPU504", "TPU505", "TPU506", "TPU507"):
             assert required in ids
+        # The family gate make analyze / CI enforces: every required
+        # family has at least one registered rule.
+        assert framework.missing_rule_families() == []
+        assert "TPU5" in framework.REQUIRED_RULE_FAMILIES
 
     def test_tpu111_goodput_prefixes_have_a_sole_writer(self, tmp_path):
         rogue = """

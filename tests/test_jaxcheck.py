@@ -1,0 +1,493 @@
+"""TPU5xx (JAX perf-correctness) rule tests: seeded positive AND
+negative fixtures per rule, noqa suppression, baseline interplay, and
+the analyze.py CLI satellites (--format github, --update-baseline
+drift pruning)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from mpi_operator_tpu.analysis import framework
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def view(tmp_path, source: str, name: str = "mod.py") -> framework.RepoView:
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return framework.RepoView(tmp_path, roots=[name])
+
+
+def run_ids(repo, select):
+    return [f.rule_id for f in framework.run(repo, select=[select])]
+
+
+# ----------------------------------------------------------------------
+# TPU501: static-looking jit parameters
+# ----------------------------------------------------------------------
+
+
+class TestJitStaticHazard:
+    def test_int_annotated_param_without_static_flags(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            @jax.jit
+            def embed(x, vocab_size: int):
+                return x * vocab_size
+        """)
+        findings = framework.run(repo, select=["TPU501"])
+        assert [f.rule_id for f in findings] == ["TPU501"]
+        assert "vocab_size" in findings[0].message
+
+    def test_static_argnames_param_is_clean(self, tmp_path):
+        repo = view(tmp_path, """
+            from functools import partial
+
+            import jax
+
+            @partial(jax.jit, static_argnames=("vocab_size",))
+            def embed(x, vocab_size: int):
+                return x * vocab_size
+        """)
+        assert framework.run(repo, select=["TPU501"]) == []
+
+    def test_static_argnums_position_is_clean(self, tmp_path):
+        repo = view(tmp_path, """
+            from functools import partial
+
+            import jax
+
+            @partial(jax.jit, static_argnums=(1,))
+            def embed(x, vocab_size: int):
+                return x * vocab_size
+        """)
+        assert framework.run(repo, select=["TPU501"]) == []
+
+    def test_literal_default_flags_and_call_form_resolves(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            def pad(x, multiple=128):
+                return x
+
+            padded = jax.jit(pad)
+        """)
+        findings = framework.run(repo, select=["TPU501"])
+        assert [f.rule_id for f in findings] == ["TPU501"]
+        assert "multiple" in findings[0].message
+
+    def test_unresolvable_static_set_is_skipped(self, tmp_path):
+        # Dynamic static_argnums: the rule cannot prove anything.
+        repo = view(tmp_path, """
+            from functools import partial
+
+            import jax
+
+            NUMS = (1,) + ()
+
+            @partial(jax.jit, static_argnums=NUMS)
+            def embed(x, vocab_size: int):
+                return x * vocab_size
+        """)
+        assert framework.run(repo, select=["TPU501"]) == []
+
+
+# ----------------------------------------------------------------------
+# TPU502: jit-in-loop / per-step closure
+# ----------------------------------------------------------------------
+
+
+class TestJitInLoop:
+    def test_jit_call_in_loop_flags(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            def sweep(fns, x):
+                for fn in fns:
+                    x = jax.jit(fn)(x)
+                return x
+        """)
+        assert run_ids(repo, "TPU502") == ["TPU502"]
+
+    def test_jit_in_step_closure_flags(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            def train_step(state, batch):
+                f = jax.jit(lambda s: s)
+                return f(state)
+        """)
+        findings = framework.run(repo, select=["TPU502"])
+        assert [f.rule_id for f in findings] == ["TPU502"]
+        assert "train_step" in findings[0].message
+
+    def test_jit_hoisted_outside_loop_is_clean(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            def sweep(fn, xs):
+                jfn = jax.jit(fn)
+                out = []
+                for x in xs:
+                    out.append(jfn(x))
+                return out
+        """)
+        assert framework.run(repo, select=["TPU502"]) == []
+
+
+# ----------------------------------------------------------------------
+# TPU503: host transfers on the step path
+# ----------------------------------------------------------------------
+
+
+class TestStepHostTransfer:
+    def test_item_in_unjitted_helper_reachable_from_step(self, tmp_path):
+        repo = view(tmp_path, """
+            def log_loss(loss):
+                return loss.item()
+
+            def train_step(state, batch):
+                loss = state + batch
+                log_loss(loss)
+                return state
+        """)
+        findings = framework.run(repo, select=["TPU503"])
+        assert [f.rule_id for f in findings] == ["TPU503"]
+        assert "log_loss" in findings[0].message
+
+    def test_device_get_wrapped_read_is_clean(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            def train_step(state, batch):
+                loss = state + batch
+                host = jax.device_get(loss)
+                record(float(host))
+                return state
+        """)
+        assert framework.run(repo, select=["TPU503"]) == []
+
+    def test_traversal_stops_at_jitted_boundary(self, tmp_path):
+        # float() below a jitted frontier is jit-traced, not a sync.
+        repo = view(tmp_path, """
+            import jax
+
+            @jax.jit
+            def inner(x):
+                return helper(x)
+
+            def helper(x):
+                return float(shape_of(x))
+
+            def train_step(state, batch):
+                return inner(state)
+        """)
+        assert framework.run(repo, select=["TPU503"]) == []
+
+    def test_param_conversion_inside_jitted_step_flags(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            @jax.jit
+            def train_step(state, batch):
+                print(state)
+                return state
+        """)
+        findings = framework.run(repo, select=["TPU503"])
+        assert [f.rule_id for f in findings] == ["TPU503"]
+
+    def test_helper_not_reachable_from_step_is_clean(self, tmp_path):
+        repo = view(tmp_path, """
+            def init_report(metrics):
+                return float(metrics.total())
+
+            def train_step(state, batch):
+                return state + batch
+        """)
+        assert framework.run(repo, select=["TPU503"]) == []
+
+
+# ----------------------------------------------------------------------
+# TPU504: donated-then-reused
+# ----------------------------------------------------------------------
+
+
+class TestDonatedReuse:
+    def test_read_after_donation_flags(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            step = jax.jit(_step, donate_argnums=(0,))
+
+            def run(state, batch):
+                new_state = step(state, batch)
+                return state  # donated buffer read again
+        """)
+        findings = framework.run(repo, select=["TPU504"])
+        assert [f.rule_id for f in findings] == ["TPU504"]
+        assert "'state'" in findings[0].message
+
+    def test_rebinding_from_result_is_clean(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            step = jax.jit(_step, donate_argnums=(0,))
+
+            def run(state, batches):
+                for batch in batches:
+                    state = step(state, batch)
+                return state
+        """)
+        assert framework.run(repo, select=["TPU504"]) == []
+
+    def test_loop_without_rebinding_flags(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            step = jax.jit(_step, donate_argnums=(0,))
+
+            def run(state, batches):
+                for batch in batches:
+                    loss = step(state, batch)
+        """)
+        findings = framework.run(repo, select=["TPU504"])
+        assert [f.rule_id for f in findings] == ["TPU504"]
+        assert "every loop iteration" in findings[0].message
+
+
+# ----------------------------------------------------------------------
+# TPU505: train step without donation
+# ----------------------------------------------------------------------
+
+
+class TestStepDonation:
+    def test_undonated_train_step_flags(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            step = jax.jit(make_train_step(model, opt))
+        """)
+        findings = framework.run(repo, select=["TPU505"])
+        assert [f.rule_id for f in findings] == ["TPU505"]
+        assert "donation" in findings[0].message
+
+    def test_donated_train_step_is_clean(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            step = jax.jit(make_train_step(model, opt),
+                           donate_argnums=(0, 1, 2))
+        """)
+        assert framework.run(repo, select=["TPU505"]) == []
+
+    def test_eval_helper_jit_is_not_a_step(self, tmp_path):
+        # Donating during eval would be wrong; no finding expected.
+        repo = view(tmp_path, """
+            import jax
+
+            stats = jax.jit(batch_stats)
+        """)
+        assert framework.run(repo, select=["TPU505"]) == []
+
+
+# ----------------------------------------------------------------------
+# TPU506: host syncs in hot loops
+# ----------------------------------------------------------------------
+
+
+class TestHotLoopSync:
+    def test_float_in_loop_driving_jitted_callable_flags(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            stats = jax.jit(batch_stats)
+
+            def evaluate(params, batches):
+                total = 0.0
+                for b in batches:
+                    loss = stats(params, b)
+                    total += float(loss)
+                return total
+        """)
+        findings = framework.run(repo, select=["TPU506"])
+        assert [f.rule_id for f in findings] == ["TPU506"]
+
+    def test_device_accumulation_is_clean(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            stats = jax.jit(batch_stats)
+
+            def evaluate(params, batches):
+                total = 0.0
+                for b in batches:
+                    total = total + stats(params, b)
+                return float(jax.device_get(total))
+        """)
+        assert framework.run(repo, select=["TPU506"]) == []
+
+    def test_cold_loop_conversions_are_clean(self, tmp_path):
+        repo = view(tmp_path, """
+            def parse(rows):
+                out = []
+                for r in rows:
+                    out.append(float(r))
+                return out
+        """)
+        assert framework.run(repo, select=["TPU506"]) == []
+
+
+# ----------------------------------------------------------------------
+# TPU507: pallas tile hygiene (ops/ scoping)
+# ----------------------------------------------------------------------
+
+
+class TestTileHygiene:
+    def test_literal_tile_default_in_ops_flags(self, tmp_path):
+        repo = view(tmp_path, """
+            def my_kernel(x, block_q: int = 128):
+                return x
+        """, name="mpi_operator_tpu/ops/custom.py")
+        findings = framework.run(repo, select=["TPU507"])
+        assert [f.rule_id for f in findings] == ["TPU507"]
+        assert "block_q" in findings[0].message
+
+    def test_shared_constant_default_is_clean(self, tmp_path):
+        repo = view(tmp_path, """
+            from ._common import DEFAULT_BLOCK_Q
+
+            def my_kernel(x, block_q: int = DEFAULT_BLOCK_Q):
+                return x
+        """, name="mpi_operator_tpu/ops/custom.py")
+        assert framework.run(repo, select=["TPU507"]) == []
+
+    def test_module_level_tile_constant_flags(self, tmp_path):
+        repo = view(tmp_path, """
+            TILE_M = 512
+        """, name="mpi_operator_tpu/ops/custom.py")
+        findings = framework.run(repo, select=["TPU507"])
+        assert [f.rule_id for f in findings] == ["TPU507"]
+
+    def test_common_py_itself_is_exempt(self, tmp_path):
+        repo = view(tmp_path, """
+            DEFAULT_BLOCK_Q = 128
+        """, name="mpi_operator_tpu/ops/_common.py")
+        assert framework.run(repo, select=["TPU507"]) == []
+
+    def test_outside_ops_is_out_of_scope(self, tmp_path):
+        repo = view(tmp_path, """
+            def helper(x, block_q: int = 128):
+                return x
+        """, name="mpi_operator_tpu/models/custom.py")
+        assert framework.run(repo, select=["TPU507"]) == []
+
+
+# ----------------------------------------------------------------------
+# noqa + baseline interplay
+# ----------------------------------------------------------------------
+
+
+class TestSuppressionAndBaseline:
+    def test_noqa_suppresses_a_tpu5_finding(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            @jax.jit
+            def embed(x, vocab_size: int):  # noqa: TPU501
+                return x * vocab_size
+        """)
+        assert framework.run(repo, select=["TPU501"]) == []
+
+    def test_baselined_tpu5_finding_is_not_new(self, tmp_path):
+        repo = view(tmp_path, """
+            import jax
+
+            @jax.jit
+            def embed(x, vocab_size: int):
+                return x * vocab_size
+        """)
+        findings = framework.run(repo, select=["TPU501"])
+        assert len(findings) == 1
+        baseline = {findings[0].baseline_key: 1}
+        assert framework.new_findings(findings, baseline) == []
+
+
+# ----------------------------------------------------------------------
+# analyze.py CLI satellites
+# ----------------------------------------------------------------------
+
+
+def _analyze(*argv, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, str(REPO_ROOT / "hack" / "analyze.py"), *argv],
+        cwd=cwd, capture_output=True, text=True,
+    )
+
+
+class TestAnalyzeCli:
+    def test_select_tpu5_is_clean_on_repo(self):
+        proc = _analyze("--select", "TPU5", "--fail-on-new")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_github_format_emits_workflow_annotations(self, tmp_path):
+        root = tmp_path / "r"
+        (root / "mpi_operator_tpu").mkdir(parents=True)
+        (root / "mpi_operator_tpu" / "mod.py").write_text(textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def embed(x, vocab_size: int):
+                return x * vocab_size
+        """))
+        proc = _analyze("--root", str(root), "--select", "TPU501",
+                        "--baseline", str(tmp_path / "empty.json"),
+                        "--format", "github")
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("::")]
+        assert len(lines) == 1
+        assert lines[0].startswith(
+            "::error file=mpi_operator_tpu/mod.py,line=")
+        assert "title=TPU501::" in lines[0]
+
+    def test_update_baseline_prunes_stale_and_reports_drift(self, tmp_path):
+        root = tmp_path / "r"
+        (root / "mpi_operator_tpu").mkdir(parents=True)
+        (root / "mpi_operator_tpu" / "mod.py").write_text(textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def embed(x, vocab_size: int):
+                return x * vocab_size
+        """))
+        baseline = tmp_path / "b.json"
+        # Seed the baseline with a stale entry that no longer exists.
+        baseline.write_text(json.dumps({
+            "version": 1,
+            "findings": {"TPU501|gone.py|old message": 1},
+        }))
+        proc = _analyze("--root", str(root), "--baseline", str(baseline),
+                        "--update-baseline")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        # update-baseline snapshots the FULL rule set, so other families
+        # contribute keys too; the drift contract is what matters: the
+        # stale entry is pruned (and reported), the live one is added.
+        assert "-1 stale" in proc.stdout
+        assert "- TPU501|gone.py|old message" in proc.stdout
+        data = json.loads(baseline.read_text())
+        keys = list(data["findings"])
+        assert "TPU501|gone.py|old message" not in keys
+        assert any(k.startswith("TPU501|mpi_operator_tpu/mod.py|")
+                   for k in keys)
+
+    def test_missing_family_gate(self, monkeypatch, tmp_path):
+        # The in-process equivalent of the CLI's registry gate.
+        monkeypatch.setattr(
+            framework, "REQUIRED_RULE_FAMILIES",
+            dict(framework.REQUIRED_RULE_FAMILIES, TPU9="imaginary"),
+        )
+        assert framework.missing_rule_families() == ["TPU9"]

@@ -1,0 +1,174 @@
+"""Runtime jit/transfer tracer tests (utils/jaxtrace): zero-cost-off,
+compile counting split at the warmup boundary, device-to-host transfer
+attribution, env arming, and the bench-harness integration smoke that
+proves the resnet train step runs recompile-free after warmup."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_operator_tpu.utils import jaxtrace
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture
+def tracer():
+    t = jaxtrace.enable()
+    try:
+        yield t
+    finally:
+        jaxtrace.disable()
+
+
+class TestZeroCostOff:
+    def test_disabled_by_default_and_noops(self):
+        assert not jaxtrace.enabled()
+        assert jaxtrace.tracer() is None
+        # Module-level annotations are no-ops with no tracer armed.
+        jaxtrace.note_step()
+        jaxtrace.note_warmup_complete()
+
+    def test_disabled_tracer_counts_nothing(self):
+        t = jaxtrace.enable()
+        jaxtrace.disable()
+        before = t.report()["transfers"]["count"]
+        x = jax.jit(lambda v: v + 1)(jnp.ones((4,)))
+        float(x[0])
+        assert t.report()["transfers"]["count"] == before
+
+    def test_env_arming_subprocess(self):
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "from mpi_operator_tpu.utils import jaxtrace; "
+             "print(jaxtrace.enabled())"],
+            cwd=REPO_ROOT, capture_output=True, text=True,
+            env={**os.environ, "TPU_JAX_TRACE": "1",
+                 "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.stdout.strip() == "True", proc.stderr
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "from mpi_operator_tpu.utils import jaxtrace; "
+             "print(jaxtrace.enabled())"],
+            cwd=REPO_ROOT, capture_output=True, text=True,
+            env={**{k: v for k, v in os.environ.items()
+                    if k != "TPU_JAX_TRACE"},
+                 "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.stdout.strip() == "False", proc.stderr
+
+
+class TestCompileCounting:
+    def test_warmup_split_and_recompile_detection(self, tracer):
+        f = jax.jit(lambda x: x * 2 + 1)
+        b = f(jnp.ones((4, 4), jnp.float32))
+        jax.block_until_ready(b)
+        tracer.note_warmup_complete()
+        for _ in range(3):
+            b = f(b)
+            tracer.note_step()
+        jax.block_until_ready(b)
+        r = tracer.report()
+        assert r["compiles"]["total"] >= 1
+        assert r["compiles"]["after_warmup"] == 0
+        assert r["steps_after_warmup"] == 3
+        tracer.assert_no_recompiles_after_warmup()
+
+        # A shape change after warmup is exactly the regression the
+        # tracer exists to catch.
+        c = f(jnp.ones((8, 8), jnp.float32))
+        jax.block_until_ready(c)
+        r = tracer.report()
+        assert r["compiles"]["after_warmup"] >= 1
+        assert r["compiles"]["sites"]  # sampled with stacks
+        with pytest.raises(jaxtrace.RecompileError):
+            tracer.assert_no_recompiles_after_warmup()
+
+
+class TestTransferCounting:
+    def test_value_reads_count_once_with_site_attribution(self, tracer):
+        f = jax.jit(lambda x: x + 1)
+        a = f(jnp.arange(16, dtype=jnp.float32))
+        jax.block_until_ready(a)
+        tracer.note_warmup_complete()
+        tracer.note_step()
+        before = tracer.report()["transfers"]
+        v = float(a[0])        # fresh array: bytes move
+        lst = a.tolist()       # first full read of `a`: bytes move
+        lst2 = a.tolist()      # cached: no bytes move
+        after = tracer.report()["transfers"]
+        assert after["count"] - before["count"] == 2
+        assert after["bytes"] - before["bytes"] >= 4 + 16 * 4
+        assert after["after_warmup_count"] >= 2
+        assert any("test_jaxtrace.py" in site
+                   for site in after["top_sites"])
+        assert tracer.report()["transfer_bytes_per_step"] > 0
+
+    def test_report_schema(self, tracer):
+        r = tracer.report()
+        assert set(r) == {"compiles", "transfers", "steps_after_warmup",
+                          "transfer_bytes_per_step"}
+        assert set(r["compiles"]) == {"total", "seconds", "after_warmup",
+                                      "sites"}
+        assert set(r["transfers"]) == {
+            "count", "bytes", "after_warmup_count", "after_warmup_bytes",
+            "top_sites",
+        }
+
+
+class TestBenchIntegration:
+    def test_resnet_step_zero_recompiles_after_warmup(self, tracer):
+        """The acceptance smoke: the real resnet train step, driven by
+        bench.py's own _timed_steps harness (which feeds the tracer its
+        warmup/step annotations), compiles during warmup and never
+        again."""
+        import optax
+
+        sys.path.insert(0, str(REPO_ROOT))
+        try:
+            import bench
+        finally:
+            sys.path.remove(str(REPO_ROOT))
+        from mpi_operator_tpu.models import resnet as resnet_lib
+
+        model = resnet_lib.resnet(18, space_to_depth=True)
+        params, batch_stats = resnet_lib.create_train_state(
+            model, jax.random.PRNGKey(0), image_size=16)
+        opt = optax.sgd(0.1, momentum=0.9)
+        opt_state = opt.init(params)
+        images = jnp.asarray(
+            np.random.RandomState(0).standard_normal((2, 16, 16, 3)),
+            jnp.bfloat16)
+        labels = jnp.asarray(
+            np.random.RandomState(1).randint(0, 1000, (2,)))
+        step = jax.jit(resnet_lib.make_train_step(model, opt),
+                       donate_argnums=(0, 1, 2))
+        fn = lambda p, b, o, i, l: step(p, b, o, i, l)[:3]  # noqa: E731
+
+        state, sec = bench._timed_steps(
+            fn, (params, batch_stats, opt_state), (images, labels),
+            steps=4, warmup=2)
+        r = tracer.report()
+        assert r["compiles"]["total"] >= 1  # warmup compiled something
+        assert r["compiles"]["after_warmup"] == 0
+        assert r["steps_after_warmup"] >= 4
+        tracer.assert_no_recompiles_after_warmup()
+
+    def test_bench_parser_has_jax_trace_flag(self):
+        sys.path.insert(0, str(REPO_ROOT))
+        try:
+            import bench
+        finally:
+            sys.path.remove(str(REPO_ROOT))
+        args = bench.build_parser().parse_args(
+            ["--suite", "resnet", "--jax-trace"])
+        assert args.jax_trace is True
