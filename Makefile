@@ -9,7 +9,8 @@ PYTHON ?= python3
 
 .PHONY: all native manifests verify-manifests lint analyze image \
         test-kernel test-kernel-smoke test-kernel-deep test-operator \
-        test test-unit test-integration test-e2e bench-goodput ci clean
+        test test-unit test-integration test-e2e bench-goodput \
+        bench-straggler ci clean
 
 all: native manifests
 
@@ -32,7 +33,7 @@ verify-manifests:
 # sandbox has neither and zero egress — docs/round4-notes.md logs the
 # attempt); the homegrown tier is the floor everywhere.
 lint: verify-manifests
-	$(PYTHON) -W error::SyntaxWarning -m compileall -q -f mpi_operator_tpu sdk hack tests bench.py bench_controlplane.py bench_goodput.py __graft_entry__.py
+	$(PYTHON) -W error::SyntaxWarning -m compileall -q -f mpi_operator_tpu sdk hack tests bench.py bench_controlplane.py bench_goodput.py bench_straggler.py __graft_entry__.py
 	$(PYTHON) hack/lint.py
 	@if $(PYTHON) -c 'import ruff' 2>/dev/null; then \
 	    $(PYTHON) -m ruff check mpi_operator_tpu sdk hack tests; \
@@ -114,7 +115,14 @@ test:
 bench-goodput:
 	$(PYTHON) bench_goodput.py --jobs 100 --seed 42 --out BENCH_GOODPUT.json
 
-ci: lint analyze native test bench-goodput
+# Seeded straggler-detection smoke (bench_straggler.py): gangs at
+# slowdown factors 1.0/2.0 on the simulated clock; gates detection
+# latency (<= consecutive-window threshold), zero false positives at
+# factor 1.0, and exact phase tiling with the skew_wait carve.
+bench-straggler:
+	$(PYTHON) bench_straggler.py --jobs 8 --seed 42 --out BENCH_STRAGGLER.json
+
+ci: lint analyze native test bench-goodput bench-straggler
 
 clean:
 	$(MAKE) -C native clean

@@ -394,6 +394,10 @@ _REQUIRED_FAMILIES = [
         "tpu_operator_job_goodput_fleet_ratio",
         "tpu_operator_job_phase_fleet_seconds",
     }),
+    ("mpi_operator_tpu/utils/stepstats.py", {
+        "tpu_operator_job_step_skew",
+        "tpu_operator_job_stragglers",
+    }),
 ]
 
 
@@ -433,6 +437,31 @@ def check_goodput_sole_writer(repo: RepoView) -> Iterable[Finding]:
                 sf.rel, line, "TPU111",
                 f"{kind}({name!r}): goodput/phase metric prefixes are "
                 f"reserved for {_GOODPUT_OWNER}",
+            )
+
+
+# The step-skew families are a cross-worker *join*: a second writer
+# would split the straggler verdicts across owners and decouple the
+# skew histogram from the skew_wait carve it explains.
+_STEPSTATS_PREFIXES = (
+    "tpu_operator_job_step", "tpu_operator_job_stragglers",
+)
+_STEPSTATS_OWNER = "mpi_operator_tpu/utils/stepstats.py"
+
+
+@rule("TPU112", "stepstats-metric-sole-writer",
+      "The tpu_operator_job_step*/tpu_operator_job_stragglers metric "
+      "prefixes are reserved for utils/stepstats.py, the step-skew "
+      "observatory.")
+def check_stepstats_sole_writer(repo: RepoView) -> Iterable[Finding]:
+    for sf, line, kind, name, _ in _metric_registrations(repo):
+        if not name.startswith(_STEPSTATS_PREFIXES):
+            continue
+        if sf.rel != _STEPSTATS_OWNER:
+            yield Finding(
+                sf.rel, line, "TPU112",
+                f"{kind}({name!r}): step-skew metric prefixes are "
+                f"reserved for {_STEPSTATS_OWNER}",
             )
 
 

@@ -48,6 +48,12 @@ ENV_JOB_NAMESPACE = "TPUJOB_NAMESPACE"
 ENV_NUM_SLICES = "TPUJOB_NUM_SLICES"
 ENV_SLICE_ID = "TPUJOB_SLICE_ID"
 
+# Chaos-injected per-worker slowdown factor (chaos SlowWorker fault →
+# LocalPodRunner child env → cmd/train.py step clock): the trainer
+# stretches every step's wall time by this factor, modelling a slow
+# host without touching the optimization math.  Unset/1.0 = no-op.
+ENV_STEP_SLOWDOWN = "TPUJOB_CHAOS_STEP_SLOWDOWN"
+
 # Cross-process trace propagation (W3C traceparent analog): the controller
 # stamps the reconcile's (trace id, span id) into every pod it builds, and
 # launcher/train adopt it on startup, so operator, launcher, and worker
@@ -77,6 +83,13 @@ DEFAULT_CLEAN_POD_POLICY = "None"
 # jax.distributed cannot change world size in place, so the controller
 # restarts stale pods with fresh env — honest restart-and-rejoin.
 WORLD_SIZE_ANNOTATION = "tpujob.kubeflow.org/world-size"
+
+# Per-worker step heartbeat (utils/telemetry.py window records), patched
+# onto the worker's own Pod by the kubelet sim (runtime/podrunner.py
+# tails the pod log for ``step_heartbeat`` JSONL lines) — the kube-native
+# transport the step-skew observatory (utils/stepstats.py) consumes via
+# the ordinary pod informer watch.  Value: one JSON object.
+STEP_HEARTBEAT_ANNOTATION = "tpujob.kubeflow.org/step-heartbeat"
 
 # ConfigMap keys (hostfile/discover_hosts.sh analogs,
 # mpi_job_controller.go:1106-1145).

@@ -64,6 +64,10 @@ JOB_FAILED = "Failed"
 # written by queue/manager.py when --enable-queue is on).
 JOB_QUOTA_RESERVED = "QuotaReserved"
 JOB_QUEUE_NOT_FOUND = "QueueNotFound"
+# Step-skew observatory verdict (utils/stepstats.py): True while the
+# gang has a detected straggler, flipped False on recovery.  Orthogonal
+# to the lifecycle conditions — a Straggling job is still Running.
+JOB_STRAGGLING = "Straggling"
 
 # podFailurePolicy actions (batch/v1 PodFailurePolicyAction analog, with
 # ``Restart`` standing in for batch's ``Count`` — the TPU operator

@@ -13,6 +13,7 @@ from .engine import (
     NODE_DEATH,
     POD_KILL,
     SERVER_ERROR,
+    SLOW_WORKER,
     TIMEOUT,
     WATCH_DELAY,
     WATCH_DROP,
@@ -20,12 +21,13 @@ from .engine import (
     ChaosEngine,
     ChaosEvent,
 )
-from .podchaos import PodKiller
+from .podchaos import PodKiller, WorkerSlower
 from .policy import (
     READ_VERBS,
     WRITE_VERBS,
     ChaosPolicy,
     PodChaos,
+    SlowWorkerChaos,
     VerbFaults,
     WatchFaults,
 )
@@ -36,6 +38,7 @@ __all__ = [
     "POD_KILL",
     "READ_VERBS",
     "SERVER_ERROR",
+    "SLOW_WORKER",
     "TIMEOUT",
     "WATCH_DELAY",
     "WATCH_DROP",
@@ -48,6 +51,8 @@ __all__ = [
     "ChaoticWatch",
     "PodChaos",
     "PodKiller",
+    "SlowWorkerChaos",
     "VerbFaults",
     "WatchFaults",
+    "WorkerSlower",
 ]

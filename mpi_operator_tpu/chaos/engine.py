@@ -27,7 +27,7 @@ from ..runtime.apiserver import (
 )
 from ..runtime import locktrace
 from ..utils.metrics import Registry, new_counter
-from .policy import ChaosPolicy, PodChaos
+from .policy import ChaosPolicy, PodChaos, SlowWorkerChaos
 
 # Fault kinds (event-log / metric label vocabulary).
 CONFLICT = "conflict"
@@ -38,6 +38,7 @@ WATCH_DELAY = "watch_delay"
 WATCH_GONE = "watch_gone"
 POD_KILL = "pod_kill"
 NODE_DEATH = "node_death"
+SLOW_WORKER = "slow_worker"
 
 
 @dataclass(frozen=True)
@@ -62,6 +63,7 @@ class ChaosEngine:
         self._lock = locktrace.lock("chaos.engine")
         self._events: list[ChaosEvent] = []
         self._kill_counts: dict[int, int] = {}
+        self._slow_counts: dict[int, int] = {}
         self.faults_total = new_counter(
             "tpu_operator_chaos_faults_injected_total",
             "Faults injected by the chaos engine, by kind.",
@@ -72,6 +74,11 @@ class ChaosEngine:
             "tpu_operator_chaos_pod_kills_total",
             "Pods killed by the chaos engine, by mode (pod_kill|node_death).",
             ("mode",),
+            registry=registry,
+        )
+        self.pod_slowdowns_total = new_counter(
+            "tpu_operator_chaos_pod_slowdowns_total",
+            "Workers degraded by the chaos engine (SlowWorker faults).",
             registry=registry,
         )
 
@@ -172,3 +179,33 @@ class ChaosEngine:
             )
         self.record(mode, f"pod {key}")
         self.pod_kills_total.inc(1.0, mode)
+
+    # -- slow workers ----------------------------------------------------
+
+    def slow_fault(
+        self, policy_index: int, policy: SlowWorkerChaos
+    ) -> bool:
+        """Decide one (policy, pod, tick)'s fate: slow the worker or not.
+        One draw per decision (the determinism contract); a landed
+        slowdown must be reported via confirm_slow so the max_slow budget
+        counts only victims that actually degraded."""
+        if policy.slow_rate <= 0.0:
+            return False
+        if policy.max_slow:
+            with self._lock:
+                if (
+                    self._slow_counts.get(policy_index, 0)
+                    >= policy.max_slow
+                ):
+                    return False
+        return self.roll() < policy.slow_rate
+
+    def confirm_slow(
+        self, policy_index: int, key: str, factor: float
+    ) -> None:
+        with self._lock:
+            self._slow_counts[policy_index] = (
+                self._slow_counts.get(policy_index, 0) + 1
+            )
+        self.record(SLOW_WORKER, f"pod {key}", f"factor={factor}")
+        self.pod_slowdowns_total.inc(1.0)

@@ -16,6 +16,8 @@ from ..api.v2beta1.constants import JOB_ROLE_LABEL
 from ..runtime.apiserver import InMemoryAPIServer
 from .engine import NODE_DEATH, POD_KILL, ChaosEngine
 
+__all__ = ["PodKiller", "WorkerSlower"]
+
 
 class PodKiller:
     def __init__(self, engine: ChaosEngine, api: InMemoryAPIServer, runner):
@@ -69,6 +71,76 @@ class PodKiller:
         self._thread = threading.Thread(
             target=self._loop, args=(interval,), daemon=True,
             name="chaos-podkiller",
+        )
+        self._thread.start()
+
+    def _loop(self, interval: float) -> None:
+        while not self._stop.is_set():
+            self.tick()
+            self._stop.wait(interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class WorkerSlower:
+    """SlowWorker chaos: each tick gives every matching running worker
+    one seeded draw deciding whether it becomes a degraded host
+    (``runner.slow_worker``, which stretches the victim's step clock by
+    the policy's factor at its next (re)start).  Already-slowed victims
+    are skipped — a straggler stays one straggler, not a compounding
+    slowdown.  Same pacing contract as PodKiller: a thread in live
+    soaks, explicit ``tick()`` calls in deterministic replays.
+    """
+
+    def __init__(self, engine: ChaosEngine, api: InMemoryAPIServer, runner):
+        self._engine = engine
+        self._api = getattr(api, "inner", api)
+        self._runner = runner
+        self._slowed: set[tuple[str, str]] = set()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def tick(self) -> int:
+        """One chaos round; returns the number of slowdowns that landed."""
+        slowed = 0
+        for index, policy in enumerate(self._engine.policy.slow):
+            if policy.slow_rate <= 0.0:
+                continue
+            pods = self._api.list("pods", policy.namespace or None)
+            for pod in pods:
+                if (pod.get("status") or {}).get("phase") != "Running":
+                    continue
+                meta = pod.get("metadata") or {}
+                labels = meta.get("labels") or {}
+                role = labels.get(JOB_ROLE_LABEL, "")
+                if policy.roles and role not in policy.roles:
+                    continue
+                key = (meta.get("namespace", ""), meta.get("name", ""))
+                if key in self._slowed:
+                    continue
+                if not self._engine.slow_fault(index, policy):
+                    continue
+                if self._runner.slow_worker(key[0], key[1], policy.factor):
+                    self._slowed.add(key)
+                    self._engine.confirm_slow(
+                        index, f"{key[0]}/{key[1]}", policy.factor
+                    )
+                    slowed += 1
+        return slowed
+
+    # -- background pacing (live soaks) ---------------------------------
+
+    def start(self, interval: float = 0.05) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, args=(interval,), daemon=True,
+            name="chaos-workerslower",
         )
         self._thread.start()
 

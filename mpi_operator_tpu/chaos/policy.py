@@ -119,6 +119,33 @@ class PodChaos:
 
 
 @dataclass(frozen=True)
+class SlowWorkerChaos:
+    """Degraded-host injection: a matching running worker is slowed by
+    ``factor`` (its step wall time stretched, optimization math intact).
+
+    Models the straggler failure mode the step-skew observatory
+    (utils/stepstats.py) exists to catch — a host that keeps making
+    progress, just slower than the gang, which pod-phase chaos can never
+    produce.  ``factor`` multiplies the worker's step clock: 1.0 is a
+    no-op (useful as the bench's control arm), 2.0 halves its step rate.
+    """
+
+    slow_rate: float = 0.0
+    factor: float = 2.0
+    roles: tuple[str, ...] = (ROLE_WORKER,)
+    namespace: str = ""  # "" = every namespace
+    max_slow: int = 0  # 0 = unlimited
+
+    def __post_init__(self) -> None:
+        _check_rate("slow_rate", self.slow_rate)
+        if self.factor < 1.0:
+            raise ValueError(
+                f"factor must be >= 1 (a speed-up is not chaos), "
+                f"got {self.factor!r}"
+            )
+
+
+@dataclass(frozen=True)
 class ChaosPolicy:
     """One replayable chaos run: seed + the active fault policies."""
 
@@ -126,6 +153,7 @@ class ChaosPolicy:
     verbs: tuple[VerbFaults, ...] = ()
     watch: Optional[WatchFaults] = None
     pods: tuple[PodChaos, ...] = ()
+    slow: tuple[SlowWorkerChaos, ...] = ()
 
     def verb_policy(self, verb: str, resource: str) -> Optional[VerbFaults]:
         """First policy matching (verb, resource); None = no faults."""

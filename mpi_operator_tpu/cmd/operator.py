@@ -38,7 +38,7 @@ from ..runtime import locktrace
 from ..runtime.apiserver import InMemoryAPIServer, NotFoundError
 from ..runtime.leaderelection import LeaderElectionConfig, LeaderElector
 from ..runtime.podrunner import LocalPodRunner
-from ..utils import flightrecorder, goodput, metrics, profiling, trace
+from ..utils import flightrecorder, goodput, metrics, profiling, stepstats, trace
 from ..utils import logging as logutil
 from ..version import version_string
 
@@ -155,25 +155,41 @@ class _MonitoringHandler(BaseHTTPRequestHandler):
     tracer: trace.Tracer = None
     flight_recorder: Optional[flightrecorder.FlightRecorder] = None
     goodput_ledger: Optional[goodput.GoodputLedger] = None
+    step_matrix: Optional[stepstats.StepMatrix] = None
     profiler: Optional[profiling.PhaseProfiler] = None
     workqueues: tuple = ()
     health_fn = staticmethod(lambda: True)
+
+    # The per-job debug leaves this server can dispatch; the unknown-leaf
+    # 404 body enumerates them so a typo'd URL is self-diagnosing.
+    KNOWN_JOB_SUBRESOURCES = ("goodput", "steps", "timeline")
 
     def _debug_jobs_response(self) -> tuple[int, str, bytes]:
         """(status, content-type, body) for the per-job debug pages:
         /debug/jobs/<ns>/<name>/timeline (with ?limit=N / ?kind=K
         filters; 400 on malformed values) and
         /debug/jobs/<ns>/<name>/goodput (the ledger's phase
-        decomposition).  404 when the page, the backing component, or
-        the job itself is unknown."""
+        decomposition), and /debug/jobs/<ns>/<name>/steps (the step-skew
+        matrix).  404 when the page, the backing component, or the job
+        itself is unknown; an unknown *leaf* on a well-formed path gets
+        a JSON body listing the known subresources."""
         import json
         from urllib.parse import urlsplit
 
         split = urlsplit(self.path)
         parts = split.path.split("/")
         # ['', 'debug', 'jobs', ns, name, leaf]
-        if len(parts) != 6 or parts[5] not in ("timeline", "goodput"):
+        if len(parts) != 6:
             return 404, "text/plain", b"not found"
+        if parts[5] not in self.KNOWN_JOB_SUBRESOURCES:
+            body = json.dumps(
+                {
+                    "error": f"unknown subresource {parts[5]!r}",
+                    "known_subresources": list(self.KNOWN_JOB_SUBRESOURCES),
+                },
+                indent=2, sort_keys=True,
+            ) + "\n"
+            return 404, "application/json", body.encode()
         namespace, name, leaf = parts[3], parts[4], parts[5]
         if leaf == "timeline":
             if self.flight_recorder is None:
@@ -188,6 +204,15 @@ class _MonitoringHandler(BaseHTTPRequestHandler):
             if timeline is None:
                 return 404, "text/plain", b"not found"
             return 200, "application/json", timeline.encode()
+        if leaf == "steps":
+            if self.step_matrix is None:
+                return 404, "text/plain", b"not found"
+            snap = self.step_matrix.job_snapshot(namespace, name)
+            if snap is None:
+                return 404, "text/plain", b"not found"
+            return 200, "application/json", (
+                json.dumps(snap, indent=2, sort_keys=True) + "\n"
+            ).encode()
         if self.goodput_ledger is None:
             return 404, "text/plain", b"not found"
         snap = self.goodput_ledger.job_snapshot(namespace, name)
@@ -268,6 +293,7 @@ def start_monitoring(port: int, registry: metrics.Registry, health_fn,
                      flight_recorder: Optional[
                          flightrecorder.FlightRecorder] = None,
                      goodput_ledger: Optional[goodput.GoodputLedger] = None,
+                     step_matrix: Optional[stepstats.StepMatrix] = None,
                      profiler: Optional[profiling.PhaseProfiler] = None,
                      workqueues=()):
     """startMonitoring (main.go:29-40) + healthz server (:192-208) analog,
@@ -275,8 +301,9 @@ def start_monitoring(port: int, registry: metrics.Registry, health_fn,
     ``/debug/jobs/<ns>/<name>/timeline`` flight-recorder endpoint (with
     ``?limit=``/``?kind=`` filters), the goodput pages
     (``/debug/jobs/<ns>/<name>/goodput`` + fleet ``/debug/goodput``),
-    and the ``/debug/profile`` phase-profile snapshot (``profiler`` plus
-    the ``workqueues`` whose health it reports)."""
+    the step-skew matrix (``/debug/jobs/<ns>/<name>/steps``), and the
+    ``/debug/profile`` phase-profile snapshot (``profiler`` plus the
+    ``workqueues`` whose health it reports)."""
     handler = type(
         "Handler",
         (_MonitoringHandler,),
@@ -286,6 +313,7 @@ def start_monitoring(port: int, registry: metrics.Registry, health_fn,
             "tracer": trace.DEFAULT_TRACER if tracer is None else tracer,
             "flight_recorder": flight_recorder,
             "goodput_ledger": goodput_ledger,
+            "step_matrix": step_matrix,
             "profiler": profiler,
             "workqueues": tuple(workqueues),
             "health_fn": staticmethod(health_fn),
@@ -400,9 +428,15 @@ def run(argv=None) -> int:
     recorder = flightrecorder.FlightRecorder()
     if runner is not None:
         runner.flight_recorder = recorder
+    # The step-skew observatory rides the recorder too (its pruning is
+    # bounded by the recorder's LRU); built before the ledger so the
+    # ledger can carve skew_wait out of productive.
+    matrix = stepstats.StepMatrix(recorder, registry=registry)
     # The goodput ledger rides the recorder: per-job phase attribution,
     # scrape-time goodput metrics, and the /debug/goodput rollup.
-    ledger = goodput.GoodputLedger(recorder, registry=registry)
+    ledger = goodput.GoodputLedger(
+        recorder, registry=registry, skew_provider=matrix.skew_wait_seconds
+    )
     is_leader = metrics.new_gauge(
         "tpu_operator_is_leader", "1 if this replica is the leader", (), registry
     )
@@ -459,6 +493,7 @@ def run(argv=None) -> int:
         gang_scheduler_name=args.gang_scheduling,
         registry=registry,
         flight_recorder=recorder,
+        step_matrix=matrix,
     )
     # Controller metrics share the exposed registry.
     if runner is not None:
@@ -555,7 +590,7 @@ def run(argv=None) -> int:
         start_monitoring(
             args.monitoring_port, registry, health,
             address=args.monitoring_address, flight_recorder=recorder,
-            goodput_ledger=ledger,
+            goodput_ledger=ledger, step_matrix=matrix,
             profiler=profiling.profiler_for(registry), workqueues=queues,
         )
         print(

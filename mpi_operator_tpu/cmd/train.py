@@ -134,6 +134,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "stays)")
     p.add_argument("--telemetry-path", default="",
                    help="append the telemetry JSONL here instead of stderr")
+    p.add_argument("--heartbeat-every", type=int, default=0,
+                   help="emit a step_heartbeat JSONL record (per-window "
+                        "step-wall p50/max, wait share) every N post-warmup "
+                        "steps; the kubelet sim tails these into pod "
+                        "annotations for the step-skew observatory. "
+                        "0 disables")
     return p
 
 
@@ -916,7 +922,25 @@ def main(argv=None) -> int:
         registry=metrics_lib.Registry(),
         interval=max(args.telemetry_every, 0),
         jsonl_path=args.telemetry_path,
+        heartbeat_interval=max(args.heartbeat_every, 0),
     )
+
+    # Chaos SlowWorker fault: the pod runner injects a per-worker step
+    # slowdown factor; stretch every step's wall clock by it so this
+    # host reads as a straggler end to end (telemetry heartbeats →
+    # pod annotation → operator step matrix) without perturbing the
+    # optimization math.
+    import os as os_mod
+
+    from ..api.v2beta1 import constants as api_constants
+
+    _slow_raw = os_mod.environ.get(api_constants.ENV_STEP_SLOWDOWN, "")
+    try:
+        step_slowdown = max(float(_slow_raw), 1.0) if _slow_raw else 1.0
+    except ValueError:
+        step_slowdown = 1.0
+    if step_slowdown > 1.0:
+        log.warning("chaos: step clock slowed by factor %.2f", step_slowdown)
 
     batches = None
     if work.batch_fn is not None:
@@ -946,6 +970,12 @@ def main(argv=None) -> int:
             work.state, loss = work.step_fn(work.state, batch)
             step += 1
             jaxtrace.note_step()
+            if step_slowdown > 1.0:
+                # Pad BEFORE timing so the stretched wall time lands in
+                # this step's telemetry (and its heartbeat window).
+                time.sleep(
+                    (step_slowdown - 1.0) * (time.perf_counter() - t_prev)
+                )
             now = time.perf_counter()
             telem.record_step(step, now - t_prev, warmup=step <= timed_from)
             t_prev = now

@@ -36,6 +36,9 @@ TPUJOB_RESUMED_REASON = "TPUJobResumed"
 # Gang-scheduler surfacing (kube-scheduler vocabulary, not kubeflow's).
 TPUJOB_SCHEDULED_REASON = "TPUJobScheduled"
 TPUJOB_UNSCHEDULABLE_REASON = "Unschedulable"
+# Step-skew observatory (utils/stepstats.py) verdicts.
+TPUJOB_STRAGGLING_REASON = "TPUJobStraggling"
+TPUJOB_STRAGGLER_RECOVERED_REASON = "TPUJobStragglerRecovered"
 
 CONDITION_TRUE = "True"
 CONDITION_FALSE = "False"

@@ -35,6 +35,7 @@ from ..api.v2beta1.types import (
     JOB_RESTARTING,
     JOB_RUNNING,
     JOB_SCHEDULED,
+    JOB_STRAGGLING,
     JOB_SUCCEEDED,
     JOB_SUSPENDED,
     KIND,
@@ -58,7 +59,7 @@ from ..runtime.client import KubeClient, SchedulingClient, TPUJobClient
 from ..runtime.informer import EventHandler, InformerFactory, meta_namespace_key, split_key
 from ..runtime.objects import KubeObject
 from ..runtime.workqueue import RateLimitingQueue
-from ..utils import flightrecorder, metrics, profiling, statemetrics, trace
+from ..utils import flightrecorder, metrics, profiling, statemetrics, stepstats, trace
 from ..utils import logging as logutil
 from ..utils.events import (
     EVENT_TYPE_NORMAL,
@@ -126,6 +127,7 @@ class TPUJobController:
         registry: Optional[metrics.Registry] = None,
         tracer: Optional[trace.Tracer] = None,
         flight_recorder: Optional[flightrecorder.FlightRecorder] = None,
+        step_matrix: Optional[stepstats.StepMatrix] = None,
         clock: Callable[[], float] = time.time,
     ):
         self.api = api
@@ -151,6 +153,15 @@ class TPUJobController:
             else flight_recorder
         )
         self.recorder.subscribe(self.flight_recorder.observe_event)
+        # Step-skew observatory: the operator constructs ONE registry-
+        # backed StepMatrix and passes it in (metric names register once
+        # per registry); the default here is metric-less, for tests and
+        # embedders that never scrape.
+        self.step_matrix = (
+            stepstats.StepMatrix(self.flight_recorder)
+            if step_matrix is None
+            else step_matrix
+        )
         self.jobs_created = metrics.new_counter(
             "tpu_operator_jobs_created_total", "Counts number of TPU jobs created",
             registry=registry,
@@ -232,6 +243,17 @@ class TPUJobController:
             self.podgroup_informer,
         ):
             informer.add_event_handler(dependent)
+        # Heartbeat intake rides the ordinary pod watch: every add/update
+        # folds the pod's step-heartbeat annotation (if any) into the
+        # matrix, and the dependent handler above already enqueues the
+        # owning job, so a fresh straggler verdict reaches
+        # _update_job_status without a dedicated resync path.
+        self.pod_informer.add_event_handler(
+            EventHandler(
+                on_add=self.step_matrix.observe_pod,
+                on_update=lambda old, new: self.step_matrix.observe_pod(new),
+            )
+        )
 
     # ------------------------------------------------------------------
     # Event handling / queue plumbing
@@ -1301,6 +1323,52 @@ class TPUJobController:
                 )
                 self.jobs_failed.inc()
                 self._delete_worker_pods_all(job)
+
+        # Step-skew verdict (utils/stepstats.py): surfaced as its own
+        # condition, orthogonal to the lifecycle ones — a Straggling job
+        # is still Running.  No verdict (None) means the matrix has not
+        # joined a window yet: say nothing rather than flip-flop.
+        if not st.is_finished(job.status):
+            verdict = self.step_matrix.straggler_verdict(
+                job.namespace, job.name
+            )
+            if verdict is not None:
+                if verdict["straggling"]:
+                    workers_msg = ", ".join(verdict["workers"])
+                    msg = truncate_message(
+                        f"TPUJob {job.namespace}/{job.name} has straggling "
+                        f"worker(s) {workers_msg}: step skew "
+                        f"{verdict['skew_ratio']:.2f}x at window "
+                        f"{verdict['window']}"
+                    )
+                    if not st.has_condition(job.status, JOB_STRAGGLING):
+                        self.recorder.event(
+                            job, EVENT_TYPE_WARNING,
+                            st.TPUJOB_STRAGGLING_REASON, msg,
+                        )
+                    self._set_condition(
+                        job, JOB_STRAGGLING, st.TPUJOB_STRAGGLING_REASON,
+                        msg, now=now,
+                        workers=verdict["workers"],
+                        skew_ratio=verdict["skew_ratio"],
+                        slowest_worker=verdict["slowest_worker"],
+                    )
+                elif st.has_condition(job.status, JOB_STRAGGLING):
+                    msg = (
+                        f"TPUJob {job.namespace}/{job.name} stragglers "
+                        f"recovered: step skew {verdict['skew_ratio']:.2f}x "
+                        f"at window {verdict['window']}"
+                    )
+                    self.recorder.event(
+                        job, EVENT_TYPE_NORMAL,
+                        st.TPUJOB_STRAGGLER_RECOVERED_REASON, msg,
+                    )
+                    self._set_condition(
+                        job, JOB_STRAGGLING,
+                        st.TPUJOB_STRAGGLER_RECOVERED_REASON, msg,
+                        status=st.CONDITION_FALSE, now=now,
+                        skew_ratio=verdict["skew_ratio"],
+                    )
 
         if job.status.to_dict() != old_status:
             self.update_status_handler(job)

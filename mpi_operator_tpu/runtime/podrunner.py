@@ -13,6 +13,11 @@ operator wires up on a cluster:
   coordinator addresses rewritten to 127.0.0.1 (the simulator's cluster
   DNS) and the JAX platform pinned to CPU for hermeticity;
 - ``restartPolicy: OnFailure`` restarts the process (bounded);
+- pod logs are tailed LIVE (a reader thread per process, not a read at
+  reap), and ``step_heartbeat`` JSONL lines the trainer emits are
+  patched onto the pod as the step-heartbeat annotation — the kubelet
+  half of the step-skew observatory (the pod informer watch carries the
+  patch to utils/stepstats.py with no new transport);
 - batch/v1 Jobs get a pod created from their template and their status
   mirrored to Complete/Failed with backoffLimit retries — the part of the
   reference flow that the kube Job controller owns
@@ -22,12 +27,13 @@ operator wires up on a cluster:
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
 
 from ..api.v2beta1 import constants
@@ -55,6 +61,10 @@ class RunningPod:
     process: subprocess.Popen
     restarts: int = 0
     log: str = ""
+    # Live stdout tail (one daemon thread per process); log appends are
+    # serialized by log_lock so pod_log() reads a consistent prefix.
+    reader: Optional[threading.Thread] = None
+    log_lock: threading.Lock = field(default_factory=threading.Lock)
 
 
 class LocalPodRunner:
@@ -82,6 +92,12 @@ class LocalPodRunner:
         self.auto_bind = auto_bind
         self.node_name = node_name
         self._pods: dict[tuple[str, str], RunningPod] = {}
+        # Chaos SlowWorker registrations: pod key -> slowdown factor,
+        # injected into the child env (ENV_STEP_SLOWDOWN) so the
+        # trainer's step clock stretches; a factor registered against a
+        # live process takes effect at its next (re)start — the runner
+        # cannot retroactively slow a running subprocess.
+        self._slow: dict[tuple[str, str], float] = {}
         self._job_pods: dict[tuple[str, str], int] = {}  # job -> failures so far
         self._lock = locktrace.rlock("podrunner")
         self._stop = threading.Event()
@@ -165,6 +181,9 @@ class LocalPodRunner:
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
         )
         env.update(self.base_env)
+        factor = self._slow.get(self._event_key(pod))
+        if factor is not None and factor > 1.0:
+            env[constants.ENV_STEP_SLOWDOWN] = str(factor)
         container = (pod["spec"].get("containers") or [{}])[0]
         for item in container.get("env") or []:
             value = str(item.get("value", ""))
@@ -226,17 +245,87 @@ class LocalPodRunner:
             if not cmd:
                 self._set_phase(key, "Failed", reason="NoCommand")
                 return
-            process = subprocess.Popen(
-                cmd,
-                env=self._child_env(pod),
-                cwd=self.workdir,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-            )
-            self._pods[key] = RunningPod(process=process)
-        self.log.info("started pod %s/%s", key[0], key[1], pid=process.pid)
+            running = self._launch(key, pod)
+            self._pods[key] = running
+        self.log.info("started pod %s/%s", key[0], key[1],
+                      pid=running.process.pid)
         self._set_phase(key, "Running")
+
+    def _launch(
+        self, key: tuple[str, str], pod: dict, restarts: int = 0, log: str = ""
+    ) -> RunningPod:
+        """Spawn the pod's process plus its log-tail thread.  The tail is
+        the kubelet-sim's live log stream: it accumulates the pod log as
+        lines arrive (pod_log() sees a running pod's output, not just a
+        dead one's) and bridges ``step_heartbeat`` JSONL lines onto the
+        pod as annotation patches."""
+        process = subprocess.Popen(
+            self._command(pod),
+            env=self._child_env(pod),
+            cwd=self.workdir,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        running = RunningPod(process=process, restarts=restarts, log=log)
+        running.reader = threading.Thread(
+            target=self._tail, args=(key, running), daemon=True,
+            name=f"podrunner-tail-{key[1]}",
+        )
+        running.reader.start()
+        return running
+
+    def _tail(self, key: tuple[str, str], running: RunningPod) -> None:
+        stdout = running.process.stdout
+        if stdout is None:  # pragma: no cover - Popen always pipes here
+            return
+        for line in stdout:
+            with running.log_lock:
+                running.log += line
+            stripped = line.strip()
+            if not stripped.startswith('{"'):
+                continue
+            try:
+                record = json.loads(stripped)
+            except ValueError:
+                continue
+            if (
+                isinstance(record, dict)
+                and record.get("event") == "step_heartbeat"
+            ):
+                self._publish_heartbeat(key, record)
+
+    def _publish_heartbeat(
+        self, key: tuple[str, str], record: dict
+    ) -> None:
+        """Patch the heartbeat onto the pod's step-heartbeat annotation
+        (get+mutate+update with conflict retry — the memory apiserver has
+        no patch verb).  The resulting MODIFIED watch event is how the
+        controller's step matrix learns about the window."""
+
+        def apply():
+            pod = self.api.get("pods", key[0], key[1])
+            meta = pod.setdefault("metadata", {})
+            annotations = dict(meta.get("annotations") or {})
+            annotations[constants.STEP_HEARTBEAT_ANNOTATION] = json.dumps(
+                record, sort_keys=True
+            )
+            meta["annotations"] = annotations
+            return self.api.update("pods", pod)
+
+        try:
+            retry.retry_on_conflict(
+                apply, retry.Backoff(steps=3, duration=0.005)
+            )
+        except NotFoundError:
+            pass  # pod deleted mid-run; nothing to annotate
+        except ConflictError:
+            pass  # next window's heartbeat will carry fresher numbers
+        except Exception:
+            self.log.debug(
+                "heartbeat annotation patch failed for %s/%s",
+                key[0], key[1],
+            )
 
     def _kill(self, key: tuple[str, str]) -> None:
         with self._lock:
@@ -254,13 +343,10 @@ class LocalPodRunner:
             if rc is None:
                 continue
             progressed = True
-            out = ""
-            if running.process.stdout:
-                try:
-                    out = running.process.stdout.read() or ""
-                except Exception:
-                    pass
-            running.log += out
+            # The tail thread owns stdout: wait for it to drain the last
+            # buffered lines so the failure message below sees them.
+            if running.reader is not None:
+                running.reader.join(timeout=5)
             try:
                 pod = self.api.get("pods", key[0], key[1])
             except NotFoundError:
@@ -281,21 +367,17 @@ class LocalPodRunner:
                     "pod %s/%s exited rc=%d; restarting (%d/%d)",
                     key[0], key[1], rc, running.restarts, MAX_RESTARTS,
                 )
-                process = subprocess.Popen(
-                    self._command(pod),
-                    env=self._child_env(pod),
-                    cwd=self.workdir,
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.STDOUT,
-                    text=True,
-                )
+                with running.log_lock:
+                    carried_log = running.log
                 with self._lock:
-                    self._pods[key] = RunningPod(
-                        process=process, restarts=running.restarts, log=running.log
+                    self._pods[key] = self._launch(
+                        key, pod, restarts=running.restarts, log=carried_log
                     )
             else:
+                with running.log_lock:
+                    tail = running.log[-1024:]
                 self._set_phase(
-                    key, "Failed", reason="Error", message=running.log[-1024:],
+                    key, "Failed", reason="Error", message=tail,
                     exit_code=exit_code,
                 )
                 with self._lock:
@@ -314,6 +396,27 @@ class LocalPodRunner:
         if running is None or running.process.poll() is not None:
             return False
         running.process.kill()
+        return True
+
+    def slow_worker(
+        self, namespace: str, name: str, factor: float
+    ) -> bool:
+        """Chaos hook: mark the pod's host slow by ``factor``.  The
+        factor reaches the trainer's step clock via ENV_STEP_SLOWDOWN at
+        the pod's next (re)start — a live subprocess cannot be slowed
+        retroactively, matching a real straggler that appears after a
+        reschedule onto a degraded host.  Returns False for pods this
+        runner does not know."""
+        if factor < 1.0:
+            return False
+        key = (namespace, name)
+        with self._lock:
+            if key not in self._pods:
+                try:
+                    self.api.get("pods", namespace, name)
+                except NotFoundError:
+                    return False
+            self._slow[key] = factor
         return True
 
     def fail_node(self, namespace: str, name: str) -> bool:
@@ -408,7 +511,10 @@ class LocalPodRunner:
     def pod_log(self, namespace: str, name: str) -> str:
         with self._lock:
             running = self._pods.get((namespace, name))
-            return running.log if running else ""
+        if running is None:
+            return ""
+        with running.log_lock:
+            return running.log
 
     # -- batch Job mirroring --------------------------------------------
 

@@ -54,6 +54,10 @@ PHASE_POD_PENDING = "pod_pending"
 PHASE_BOOTSTRAP = "bootstrap"
 PHASE_PRODUCTIVE = "productive"
 PHASE_CHECKPOINT = "checkpoint"
+# Gang wall time lost waiting on the slowest worker each step — carved
+# out of productive by the step-skew join (utils/stepstats.py), the same
+# way checkpoint seconds are carved by the telemetry join.
+PHASE_SKEW_WAIT = "skew_wait"
 PHASE_RESTART_DOWNTIME = "restart_downtime"
 UNATTRIBUTED = "unattributed"
 
@@ -64,6 +68,7 @@ GOODPUT_PHASES = (
     PHASE_BOOTSTRAP,
     PHASE_PRODUCTIVE,
     PHASE_CHECKPOINT,
+    PHASE_SKEW_WAIT,
     PHASE_RESTART_DOWNTIME,
     UNATTRIBUTED,
 )
@@ -226,9 +231,14 @@ class GoodputLedger:
         flight_recorder: flightrecorder.FlightRecorder,
         registry: Optional[metrics.Registry] = None,
         clock: Callable[[], float] = time.time,
+        skew_provider: Optional[Callable[[str, str], float]] = None,
     ):
         self._recorder = flight_recorder
         self._clock = clock
+        # (namespace, name) -> cumulative skew-wait seconds; the operator
+        # wires StepMatrix.skew_wait_seconds here so gang stall time is
+        # carved out of productive (zero-arg default: no observatory).
+        self._skew_provider = skew_provider
         self._lock = threading.Lock()
         # Latest train_telemetry record per job (checkpoint_s join).
         self._telemetry: dict[tuple[str, str], dict] = {}
@@ -297,6 +307,18 @@ class GoodputLedger:
         carve = min(checkpoint_s, phases[PHASE_PRODUCTIVE])
         phases[PHASE_CHECKPOINT] += carve
         phases[PHASE_PRODUCTIVE] -= carve
+        # Skew-wait carve mirrors the checkpoint one: both are wall time
+        # the job spent nominally "training" but not making progress, and
+        # both are clamped so the tiling invariant (phases sum to wall)
+        # survives a noisy estimate.
+        skew_s = (
+            float(self._skew_provider(namespace, name))
+            if self._skew_provider is not None
+            else 0.0
+        )
+        skew_carve = min(max(skew_s, 0.0), phases[PHASE_PRODUCTIVE])
+        phases[PHASE_SKEW_WAIT] += skew_carve
+        phases[PHASE_PRODUCTIVE] -= skew_carve
         wall = att["wall_seconds"]
         attributed = sum(phases[p] for p in GOODPUT_PHASES if p != UNATTRIBUTED)
         phases[UNATTRIBUTED] += max(0.0, wall - attributed)

@@ -12,7 +12,15 @@ for ``cmd.train``:
   registry shape the operator scrapes, so a sidecar exporter can serve it);
 - a compact JSONL record is emitted every ``interval`` steps (and on
   ``close()``) to a file and/or stderr, one object per line, so progress is
-  greppable from pod logs without parsing the human log lines.
+  greppable from pod logs without parsing the human log lines;
+- every record is stamped with this worker's identity (``TPU_WORKER_ID``
+  and hostname, read once at construction), so per-worker JSONL streams
+  are joinable without path-name archaeology;
+- with ``heartbeat_interval`` set, a windowed ``step_heartbeat`` record
+  (step-wall p50/max, barrier/collective-wait share) is emitted every N
+  post-warmup steps and handed to an optional publisher — the raw input
+  of the operator-side step-skew observatory (utils/stepstats.py), which
+  joins heartbeats across workers to find stragglers.
 
 Step durations are dispatch-to-dispatch wall times: JAX dispatch is async,
 so an individual step's number can lag its true device time, but the
@@ -22,10 +30,13 @@ step time without forcing a device sync per step.
 
 from __future__ import annotations
 
+import os
+import socket
 import sys
 import time
 from typing import Callable, Optional, TextIO
 
+from ..api.v2beta1 import constants
 from . import metrics
 from .logging import emit_json
 
@@ -56,6 +67,8 @@ class TrainingTelemetry:
         jsonl_path: str = "",
         stream: Optional[TextIO] = None,
         clock: Callable[[], float] = time.perf_counter,
+        heartbeat_interval: int = 0,
+        heartbeat_publisher: Optional[Callable[[dict], None]] = None,
     ):
         self.tokens_per_step = tokens_per_step
         self.examples_per_step = examples_per_step
@@ -65,6 +78,21 @@ class TrainingTelemetry:
         self._file: Optional[TextIO] = None
         if jsonl_path:
             self._file = open(jsonl_path, "a", buffering=1)
+
+        # Worker identity, read ONCE at construction (the pod env never
+        # changes mid-process): joins the per-worker JSONL streams.
+        worker = os.environ.get(constants.ENV_TPU_WORKER_ID, "").strip()
+        self.worker_id: Optional[int] = int(worker) if worker.isdigit() else None
+        self.hostname = os.environ.get("HOSTNAME") or socket.gethostname()
+
+        # Windowed step heartbeats (the step-skew observatory's input):
+        # every ``heartbeat_interval`` post-warmup steps, one compact
+        # record with the window's step-wall p50/max and wait share.
+        self.heartbeat_interval = max(heartbeat_interval, 0)
+        self.heartbeat_publisher = heartbeat_publisher
+        self._hb_durations: list[float] = []
+        self._hb_wait_s = 0.0
+        self._hb_window = 0
 
         registry = registry or metrics.DEFAULT_REGISTRY
         self.registry = registry
@@ -116,7 +144,17 @@ class TrainingTelemetry:
         self._origin = self._clock() - prior_wall_s
         self._last_emit_time = self._clock()
 
-    def record_step(self, step: int, duration_s: float, *, warmup: bool = False) -> None:
+    def record_step(
+        self,
+        step: int,
+        duration_s: float,
+        *,
+        warmup: bool = False,
+        wait_s: float = 0.0,
+    ) -> None:
+        """``wait_s`` is the slice of this step spent blocked on the gang
+        (barrier/collective wait) when the workload can tell it apart —
+        it feeds the heartbeat's wait share, never the goodput split."""
         if self._origin is None:
             self.start()
         self.step_duration.observe(duration_s)
@@ -127,8 +165,62 @@ class TrainingTelemetry:
                 self.tokens_total.inc(self.tokens_per_step)
             if self.examples_per_step:
                 self.examples_total.inc(self.examples_per_step)
+            if self.heartbeat_interval:
+                # Warmup (compile) steps stay out of the window: their
+                # wall times would read as fake skew to the detector.
+                self._hb_durations.append(duration_s)
+                self._hb_wait_s += max(0.0, min(wait_s, duration_s))
+                if len(self._hb_durations) >= self.heartbeat_interval:
+                    self.emit_heartbeat(step)
         if self.interval and step % self.interval == 0:
             self.emit(step)
+
+    def _stamp_identity(self, rec: dict) -> dict:
+        """Every emitted record carries the worker's identity so the
+        per-pod JSONL files (and the tailed pod logs) join by content."""
+        if self.worker_id is not None:
+            rec["worker_id"] = self.worker_id
+        rec["hostname"] = self.hostname
+        return rec
+
+    def emit_heartbeat(self, step: int) -> Optional[dict]:
+        """Close the current heartbeat window: emit one ``step_heartbeat``
+        JSONL record and hand it to the publisher (in the pods the
+        kubelet sim tails, that record becomes a pod annotation patch).
+        Returns None when the window is empty."""
+        durations = sorted(self._hb_durations)
+        if not durations:
+            return None
+        n = len(durations)
+        mid = n // 2
+        p50 = (
+            durations[mid]
+            if n % 2
+            else (durations[mid - 1] + durations[mid]) / 2.0
+        )
+        total = sum(durations)
+        rec = self._stamp_identity({
+            "event": "step_heartbeat",
+            "window": self._hb_window,
+            "step": step,
+            "steps": n,
+            "step_wall_p50_ms": round(p50 * 1000, 3),
+            "step_wall_max_ms": round(durations[-1] * 1000, 3),
+            "wait_share": round(self._hb_wait_s / total, 4) if total > 0 else 0.0,
+            "window_s": round(total, 6),
+        })
+        self._hb_window += 1
+        self._hb_durations = []
+        self._hb_wait_s = 0.0
+        emit_json(rec, stream=self._file if self._file is not None else self._stream)
+        if self.heartbeat_publisher is not None:
+            try:
+                self.heartbeat_publisher(rec)
+            except Exception:
+                # A broken publisher (apiserver away, annotation conflict
+                # storm) must never take the training loop down with it.
+                pass
+        return rec
 
     def record_checkpoint(self, duration_s: float) -> None:
         """Charge durable-save wall time.  Checkpoint seconds stay in the
@@ -163,14 +255,14 @@ class TrainingTelemetry:
         per_step = window_productive / window_steps if window_steps > 0 else 0.0
         rate = window_steps / window_s if window_s > 0 else 0.0
         goodput = self.goodput_ratio()
-        rec = {
+        rec = self._stamp_identity({
             "event": "train_telemetry",
             "step": step,
             "step_ms": round(per_step * 1000, 3),
             "steps_per_sec": round(rate, 3),
             "goodput": round(goodput, 4),
             "wall_s": round(self.wall_s(), 3),
-        }
+        })
         if self.tokens_per_step:
             rec["tokens_per_sec"] = round(rate * self.tokens_per_step, 1)
         if self.examples_per_step:
@@ -202,6 +294,10 @@ class TrainingTelemetry:
         so a killed worker's partial goodput and step count are never
         lost with the process."""
         rec = None
+        if self.heartbeat_interval and self._hb_durations:
+            # Flush the partial window: a preempted worker's last steps
+            # still reach the operator-side step matrix.
+            self.emit_heartbeat(step)
         if final or (self.interval and step > self._last_emit_step):
             rec = self.emit(step, final=final)
         if self._file is not None:

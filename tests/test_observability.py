@@ -546,6 +546,115 @@ class TestTrainingTelemetry:
 
 
 # ---------------------------------------------------------------------------
+# Step heartbeats (the step-skew observatory's worker side)
+# ---------------------------------------------------------------------------
+
+
+class TestStepHeartbeats:
+    def _telem(self, **kw):
+        t = [100.0]
+        buf = io.StringIO()
+        kw.setdefault("registry", metrics.Registry())
+        tm = telemetry.TrainingTelemetry(
+            stream=buf, clock=lambda: t[0], **kw
+        )
+        return tm, t, buf
+
+    def _heartbeats(self, buf):
+        return [
+            json.loads(ln) for ln in buf.getvalue().strip().splitlines()
+            if json.loads(ln).get("event") == "step_heartbeat"
+        ]
+
+    def test_window_closes_every_interval_with_p50_max(self):
+        published = []
+        tm, _, buf = self._telem(
+            heartbeat_interval=3, heartbeat_publisher=published.append
+        )
+        tm.start()
+        for step, dur in enumerate((0.1, 0.2, 0.1, 0.1, 0.1, 0.4), start=1):
+            tm.record_step(step, dur)
+        recs = self._heartbeats(buf)
+        assert [r["window"] for r in recs] == [0, 1]
+        assert recs[0]["steps"] == 3 and recs[0]["step"] == 3
+        assert recs[0]["step_wall_p50_ms"] == pytest.approx(100.0)
+        assert recs[0]["step_wall_max_ms"] == pytest.approx(200.0)
+        assert recs[1]["step_wall_max_ms"] == pytest.approx(400.0)
+        # The publisher saw exactly the emitted records.
+        assert published == recs
+
+    def test_warmup_steps_stay_out_of_the_window(self):
+        tm, _, buf = self._telem(heartbeat_interval=2)
+        tm.start()
+        tm.record_step(1, 9.0, warmup=True)  # compile: not fake skew
+        tm.record_step(2, 0.1)
+        tm.record_step(3, 0.1)
+        (rec,) = self._heartbeats(buf)
+        assert rec["step_wall_p50_ms"] == pytest.approx(100.0)
+        assert rec["steps"] == 2
+
+    def test_wait_share_fraction_of_window(self):
+        tm, _, buf = self._telem(heartbeat_interval=2)
+        tm.start()
+        tm.record_step(1, 0.1, wait_s=0.05)
+        tm.record_step(2, 0.1, wait_s=0.05)
+        (rec,) = self._heartbeats(buf)
+        assert rec["wait_share"] == pytest.approx(0.5)
+
+    def test_close_flushes_partial_window(self):
+        tm, _, buf = self._telem(heartbeat_interval=10)
+        tm.start()
+        tm.record_step(1, 0.1)
+        tm.record_step(2, 0.1)
+        tm.close(2)
+        (rec,) = self._heartbeats(buf)
+        assert rec["steps"] == 2 and rec["window"] == 0
+
+    def test_broken_publisher_never_breaks_the_loop(self):
+        tm, _, buf = self._telem(
+            heartbeat_interval=1,
+            heartbeat_publisher=lambda rec: 1 / 0,
+        )
+        tm.start()
+        tm.record_step(1, 0.1)  # must not raise
+        assert len(self._heartbeats(buf)) == 1
+
+    def test_identity_stamped_into_every_record(self, monkeypatch):
+        monkeypatch.setenv(constants.ENV_TPU_WORKER_ID, "3")
+        monkeypatch.setenv("HOSTNAME", "host-3.example")
+        tm, _, buf = self._telem(heartbeat_interval=1, interval=1)
+        tm.start()
+        tm.record_step(1, 0.1)
+        recs = [json.loads(ln) for ln in buf.getvalue().strip().splitlines()]
+        assert {r["event"] for r in recs} == {
+            "step_heartbeat", "train_telemetry",
+        }
+        for rec in recs:
+            assert rec["worker_id"] == 3
+            assert rec["hostname"] == "host-3.example"
+
+    def test_final_emit_exactly_once(self):
+        """The SIGTERM path: ``close(step, final=True)`` must emit ONE
+        record carrying ``"final": true`` even with periodic records
+        disabled — a preempted worker's last goodput never dies with the
+        process, and never double-reports either."""
+        tm, _, buf = self._telem(interval=0)
+        tm.start()
+        tm.record_step(1, 0.1)
+        tm.close(1, final=True)
+        recs = [json.loads(ln) for ln in buf.getvalue().strip().splitlines()]
+        finals = [r for r in recs if r.get("final")]
+        assert len(finals) == 1
+        assert finals[0]["event"] == "train_telemetry"
+        # Plain shutdown (interval=0, no final): nothing emitted.
+        tm2, _, buf2 = self._telem(interval=0)
+        tm2.start()
+        tm2.record_step(1, 0.1)
+        tm2.close(1)
+        assert buf2.getvalue() == ""
+
+
+# ---------------------------------------------------------------------------
 # Cross-process trace context
 # ---------------------------------------------------------------------------
 
@@ -1192,6 +1301,103 @@ class TestTimelineEndpoint:
                     )
                 assert exc_info.value.code == 400, query
                 assert b"bad request" in exc_info.value.read()
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+class TestStepsEndpoint:
+    """/debug/jobs/<ns>/<name>/steps serves the live step-skew matrix;
+    an unknown leaf on a well-formed path self-diagnoses with a JSON
+    body enumerating the known subresources."""
+
+    def _matrix(self):
+        from mpi_operator_tpu.api.v2beta1 import constants as c
+        from mpi_operator_tpu.utils import stepstats
+
+        fr = flightrecorder.FlightRecorder(clock=lambda: 0.0)
+        matrix = stepstats.StepMatrix(fr, clock=lambda: 0.0)
+
+        def pod(i, record=None):
+            doc = {
+                "metadata": {
+                    "name": f"j1-worker-{i}",
+                    "namespace": "default",
+                    "labels": {
+                        c.JOB_NAME_LABEL: "j1",
+                        c.JOB_ROLE_LABEL: c.ROLE_WORKER,
+                        c.REPLICA_INDEX_LABEL: str(i),
+                    },
+                },
+                "status": {"phase": "Running"},
+            }
+            if record is not None:
+                doc["metadata"]["annotations"] = {
+                    c.STEP_HEARTBEAT_ANNOTATION: json.dumps(record)
+                }
+            return doc
+
+        # Roster first (the ordinary informer add), then windows arrive
+        # gang-by-gang the way live heartbeats do.
+        for i in range(2):
+            matrix.observe_pod(pod(i))
+        for window in range(3):
+            for i in range(2):
+                matrix.observe_pod(pod(i, {
+                    "window": window,
+                    "step": (window + 1) * 10,
+                    "steps": 10,
+                    "step_wall_p50_ms": 100.0,
+                    "step_wall_max_ms": 110.0,
+                    "wait_share": 0.0,
+                }))
+        return matrix
+
+    def test_steps_serves_matrix_snapshot(self):
+        server, base = _monitoring_server(step_matrix=self._matrix())
+        try:
+            resp = urllib.request.urlopen(
+                base + "/debug/jobs/default/j1/steps", timeout=5
+            )
+            assert resp.headers["Content-Type"] == "application/json"
+            snap = json.loads(resp.read().decode())
+            assert snap["name"] == "j1" and snap["straggling"] is False
+            assert sorted(snap["workers"]) == ["0", "1"]
+            assert snap["windows"] and snap["windows"][0]["workers"] == 2
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_steps_404_without_matrix_or_for_unknown_job(self):
+        for attrs in ({}, {"step_matrix": self._matrix()}):
+            server, base = _monitoring_server(**attrs)
+            try:
+                with pytest.raises(urllib.error.HTTPError) as exc_info:
+                    urllib.request.urlopen(
+                        base + "/debug/jobs/default/ghost/steps", timeout=5
+                    )
+                assert exc_info.value.code == 404
+            finally:
+                server.shutdown()
+                server.server_close()
+
+    def test_unknown_subresource_lists_known_ones(self):
+        server, base = _monitoring_server(
+            flight_recorder=flightrecorder.FlightRecorder()
+        )
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(
+                    base + "/debug/jobs/default/j1/bogus", timeout=5
+                )
+            err = exc_info.value
+            assert err.code == 404
+            assert err.headers["Content-Type"] == "application/json"
+            body = json.loads(err.read().decode())
+            assert body["error"] == "unknown subresource 'bogus'"
+            assert body["known_subresources"] == [
+                "goodput", "steps", "timeline",
+            ]
         finally:
             server.shutdown()
             server.server_close()

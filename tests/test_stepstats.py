@@ -1,0 +1,501 @@
+"""Step-skew observatory tests (utils/stepstats.py + friends).
+
+The StepMatrix's contract, exercised layer by layer: heartbeat windows
+join only when the whole known gang has reported (roster
+pre-registration from ordinary pod events), the straggler detector
+needs M consecutive over-threshold windows and recovers symmetrically,
+skew-wait accrues only above the threshold (jitter stays productive),
+the flight recorder's LRU transitively bounds the matrix (satellite:
+eviction pressure must prune scrape-time gauge series too), the
+SlowWorker chaos surface is seeded-deterministic and budgeted, the
+controller surfaces/clears the ``Straggling`` condition, and the
+straggler bench reproduces bit-identically from its seed.
+"""
+
+import json
+
+import pytest
+
+import bench_straggler as bench
+from mpi_operator_tpu import chaos
+from mpi_operator_tpu.api.v2beta1 import constants
+from mpi_operator_tpu.api.v2beta1.types import JOB_STRAGGLING
+from mpi_operator_tpu.controller import status as st
+from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer
+from mpi_operator_tpu.utils import flightrecorder, goodput, metrics, stepstats
+
+from tests.test_controller import Fixture, make_synced_job
+
+
+def heartbeat(window, p50_ms, steps=10, **extra):
+    rec = {
+        "event": "step_heartbeat",
+        "window": window,
+        "step": (window + 1) * steps,
+        "steps": steps,
+        "step_wall_p50_ms": p50_ms,
+        "step_wall_max_ms": round(p50_ms * 1.1, 3),
+        "wait_share": 0.0,
+        "window_s": round(p50_ms * steps / 1000.0, 6),
+    }
+    rec.update(extra)
+    return rec
+
+
+def worker_pod(index, job="j1", namespace="default", phase="Running",
+               record=None, role=constants.ROLE_WORKER):
+    pod = {
+        "metadata": {
+            "name": f"{job}-worker-{index}",
+            "namespace": namespace,
+            "labels": {
+                constants.JOB_NAME_LABEL: job,
+                constants.JOB_ROLE_LABEL: role,
+                constants.REPLICA_INDEX_LABEL: str(index),
+            },
+        },
+        "status": {"phase": phase},
+    }
+    if record is not None:
+        pod["metadata"]["annotations"] = {
+            constants.STEP_HEARTBEAT_ANNOTATION: json.dumps(
+                record, sort_keys=True
+            )
+        }
+    return pod
+
+
+def make_matrix(registry=None, **kw):
+    fr = flightrecorder.FlightRecorder(clock=lambda: 0.0)
+    matrix = stepstats.StepMatrix(
+        fr, registry=registry, clock=lambda: 0.0, **kw
+    )
+    return matrix, fr
+
+
+def register_roster(matrix, workers, job="j1"):
+    for i in range(workers):
+        matrix.observe_pod(worker_pod(i, job=job))
+
+
+def emit_window(matrix, window, p50s, job="j1"):
+    """One joined window: worker i reports p50s[i] ms."""
+    for i, p50 in enumerate(p50s):
+        matrix.observe_pod(
+            worker_pod(i, job=job, record=heartbeat(window, p50))
+        )
+
+
+# ---------------------------------------------------------------------------
+# Window join semantics
+# ---------------------------------------------------------------------------
+
+
+class TestStepMatrixJoin:
+    def test_roster_gates_first_window_until_gang_reports(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 4)
+        # First arrival alone must NOT close the window: the informer
+        # already told the matrix the gang has 4 members.
+        matrix.observe_pod(worker_pod(0, record=heartbeat(0, 100.0)))
+        assert matrix.straggler_verdict("default", "j1") is None
+        for i in (1, 2, 3):
+            matrix.observe_pod(worker_pod(i, record=heartbeat(0, 100.0)))
+        verdict = matrix.straggler_verdict("default", "j1")
+        assert verdict is not None
+        assert verdict["window"] == 0
+        assert verdict["straggling"] is False
+        assert verdict["skew_ratio"] == pytest.approx(1.0)
+
+    def test_single_member_windows_produce_no_stats(self):
+        # Without a roster, a lone worker's windows close solo; skew of a
+        # gang of one is meaningless, so no verdict ever forms.
+        matrix, _ = make_matrix()
+        for window in range(3):
+            matrix.observe_pod(
+                worker_pod(0, record=heartbeat(window, 100.0))
+            )
+        assert matrix.straggler_verdict("default", "j1") is None
+
+    def test_duplicate_delivery_is_idempotent(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 2)
+        matrix.observe_pod(worker_pod(0, record=heartbeat(0, 100.0)))
+        matrix.observe_pod(worker_pod(0, record=heartbeat(0, 100.0)))
+        matrix.observe_pod(worker_pod(1, record=heartbeat(0, 120.0)))
+        snap = matrix.job_snapshot("default", "j1")
+        assert [w["window"] for w in snap["windows"]] == [0]
+        assert snap["windows"][0]["workers"] == 2
+
+    def test_unready_window_blocks_later_ones(self):
+        # Windows close in order: worker 1 skipped window 0, so even the
+        # fully-reported window 1 must wait (the detector's consecutive
+        # counters need one monotone window sequence).
+        matrix, _ = make_matrix()
+        register_roster(matrix, 2)
+        matrix.observe_pod(worker_pod(0, record=heartbeat(0, 100.0)))
+        matrix.observe_pod(worker_pod(0, record=heartbeat(1, 100.0)))
+        matrix.observe_pod(worker_pod(1, record=heartbeat(1, 100.0)))
+        assert matrix.straggler_verdict("default", "j1") is None
+
+    def test_lagged_windows_force_close_and_terminal_pod_leaves_roster(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 4)
+        # Worker 3 never heartbeats (hung host): the first windows close
+        # only once they lag MAX_OPEN_WINDOW_LAG behind the newest.
+        for window in range(stepstats.MAX_OPEN_WINDOW_LAG + 1):
+            for i in (0, 1, 2):
+                matrix.observe_pod(
+                    worker_pod(i, record=heartbeat(window, 100.0))
+                )
+        verdict = matrix.straggler_verdict("default", "j1")
+        assert verdict is not None and verdict["window"] == 0
+        # The dead worker's terminal pod prunes the roster, unwedging
+        # every later window for the living.
+        matrix.observe_pod(worker_pod(3, phase="Failed"))
+        verdict = matrix.straggler_verdict("default", "j1")
+        assert verdict["window"] == stepstats.MAX_OPEN_WINDOW_LAG
+
+    def test_terminal_heartbeat_folds_then_leaves_roster(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 2)
+        matrix.observe_pod(worker_pod(0, record=heartbeat(0, 100.0)))
+        matrix.observe_pod(
+            worker_pod(1, phase="Succeeded", record=heartbeat(0, 100.0))
+        )
+        snap = matrix.job_snapshot("default", "j1")
+        # The final flush joined the window...
+        assert snap["windows"][0]["workers"] == 2
+        # ...but the finished worker no longer gates future windows.
+        assert sorted(snap["workers"]) == ["0"]
+
+    def test_non_worker_and_unlabeled_pods_ignored(self):
+        matrix, _ = make_matrix()
+        matrix.observe_pod(
+            worker_pod(0, role="launcher", record=heartbeat(0, 100.0))
+        )
+        pod = worker_pod(1, record=heartbeat(0, 100.0))
+        del pod["metadata"]["labels"][constants.JOB_NAME_LABEL]
+        matrix.observe_pod(pod)
+        matrix.observe_pod(worker_pod(2, record={"not": "a heartbeat"}))
+        assert len(matrix) == 0
+
+    def test_malformed_annotation_ignored(self):
+        matrix, _ = make_matrix()
+        pod = worker_pod(0)
+        pod["metadata"]["annotations"] = {
+            constants.STEP_HEARTBEAT_ANNOTATION: "{not json"
+        }
+        matrix.observe_pod(pod)
+        # The pod still registers nothing (no roster without a valid
+        # parse path is fine — the plain informer event does that).
+        assert matrix.straggler_verdict("default", "j1") is None
+
+
+# ---------------------------------------------------------------------------
+# Straggler detector + skew-wait accrual
+# ---------------------------------------------------------------------------
+
+
+class TestStragglerDetector:
+    def test_detects_after_consecutive_windows(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 4)
+        for window in range(stepstats.DEFAULT_CONSECUTIVE_WINDOWS):
+            emit_window(matrix, window, [100.0, 100.0, 100.0, 200.0])
+            verdict = matrix.straggler_verdict("default", "j1")
+            expected = (
+                window == stepstats.DEFAULT_CONSECUTIVE_WINDOWS - 1
+            )
+            assert verdict["straggling"] is expected, f"window {window}"
+        assert verdict["workers"] == ["3"]
+        assert verdict["slowest_worker"] == "3"
+        assert verdict["skew_ratio"] == pytest.approx(2.0)
+
+    def test_one_off_spikes_never_accumulate(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 4)
+        for window in range(6):
+            slow = 200.0 if window % 2 == 0 else 100.0
+            emit_window(matrix, window, [100.0, 100.0, 100.0, slow])
+        assert matrix.straggler_verdict("default", "j1")["straggling"] is False
+
+    def test_recovery_clears_straggler_set(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 4)
+        for window in range(3):
+            emit_window(matrix, window, [100.0, 100.0, 100.0, 200.0])
+        assert matrix.straggler_verdict("default", "j1")["straggling"]
+        emit_window(matrix, 3, [100.0, 100.0, 100.0, 100.0])
+        verdict = matrix.straggler_verdict("default", "j1")
+        assert verdict["straggling"] is False and verdict["workers"] == []
+
+    def test_skew_wait_accrues_only_above_threshold(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 4)
+        # 1.4x skew: real, but under the 1.5x threshold — ordinary
+        # jitter must not bleed skew_wait out of productive.
+        emit_window(matrix, 0, [100.0, 100.0, 100.0, 140.0])
+        assert matrix.skew_wait_seconds("default", "j1") == 0.0
+        # 2x skew over a 10-step window: (200-100)ms x 10 = 1s of gang
+        # wall clock lost to the straggler.
+        emit_window(matrix, 1, [100.0, 100.0, 100.0, 200.0])
+        assert matrix.skew_wait_seconds("default", "j1") == pytest.approx(1.0)
+        assert matrix.skew_wait_seconds("default", "ghost") == 0.0
+
+    def test_constructor_validation(self):
+        fr = flightrecorder.FlightRecorder()
+        with pytest.raises(ValueError, match="skew_threshold"):
+            stepstats.StepMatrix(fr, skew_threshold=1.0)
+        with pytest.raises(ValueError, match="consecutive_windows"):
+            stepstats.StepMatrix(fr, consecutive_windows=0)
+
+    def test_snapshot_shape(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 4)
+        for window in range(3):
+            emit_window(matrix, window, [100.0, 100.0, 100.0, 200.0])
+        snap = matrix.job_snapshot("default", "j1")
+        assert snap["straggling"] is True and snap["stragglers"] == ["3"]
+        assert snap["skew_threshold"] == stepstats.DEFAULT_SKEW_THRESHOLD
+        assert snap["workers"]["3"]["consecutive_slow_windows"] == 3
+        assert snap["workers"]["3"]["straggling"] is True
+        assert snap["workers"]["0"]["straggling"] is False
+        assert len(snap["windows"]) == 3
+        assert matrix.job_snapshot("default", "ghost") is None
+
+
+# ---------------------------------------------------------------------------
+# Metrics + LRU-transitive pruning (satellite: eviction pressure)
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsAndPruning:
+    def test_scrape_exposes_skew_histogram_and_straggler_gauge(self):
+        registry = metrics.Registry()
+        fr = flightrecorder.FlightRecorder(clock=lambda: 0.0)
+        matrix = stepstats.StepMatrix(fr, registry=registry)
+        fr.record("default", "j1", flightrecorder.EVENT, reason="Created")
+        for i in range(4):
+            matrix.observe_pod(worker_pod(i))
+        for window in range(3):
+            emit_window(matrix, window, [100.0, 100.0, 100.0, 200.0])
+        text = registry.expose()
+        assert (
+            'tpu_operator_job_stragglers{namespace="default",tpujob="j1"} 1'
+            in text
+        )
+        # The 2.0x windows land in the <= 2.0 skew bucket.
+        assert (
+            'tpu_operator_job_step_skew_bucket{le="2.0"} 3' in text
+        )
+        assert "tpu_operator_job_step_skew_count 3" in text
+
+    def test_recorder_eviction_prunes_matrix_and_gauge_series(self):
+        """Eviction pressure: when the flight recorder's LRU drops a job,
+        the next scrape must drop its StepMatrix state AND its
+        ``tpu_operator_job_stragglers`` series — the recorder's
+        ``max_jobs`` is the one knob bounding both tables."""
+        registry = metrics.Registry()
+        fr = flightrecorder.FlightRecorder(max_jobs=2, clock=lambda: 0.0)
+        matrix = stepstats.StepMatrix(fr, registry=registry)
+        for job in ("a", "b"):
+            fr.record("default", job, flightrecorder.EVENT, reason="Created")
+            for i in range(2):
+                matrix.observe_pod(worker_pod(i, job=job))
+            emit_window(matrix, 0, [100.0, 100.0], job=job)
+        text = registry.expose()
+        assert 'tpujob="a"' in text and 'tpujob="b"' in text
+        assert len(matrix) == 2
+
+        # Two fresh jobs push a and b out of the recorder's LRU.
+        fr.record("default", "c", flightrecorder.EVENT, reason="Created")
+        fr.record("default", "d", flightrecorder.EVENT, reason="Created")
+        assert fr.timeline("default", "a") is None
+        text = registry.expose()
+        assert 'tpujob="a"' not in text and 'tpujob="b"' not in text
+        assert len(matrix) == 0
+        assert matrix.job_snapshot("default", "a") is None
+        assert matrix.skew_wait_seconds("default", "a") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# SlowWorker chaos
+# ---------------------------------------------------------------------------
+
+
+class TestWorkerSlowerChaos:
+    def _fleet(self, seed, slow_rate=1.0, factor=2.0, max_slow=0):
+        api = InMemoryAPIServer()
+        for i in range(4):
+            api.create("pods", worker_pod(i))
+        engine = chaos.ChaosEngine(chaos.ChaosPolicy(
+            seed=seed,
+            slow=(chaos.SlowWorkerChaos(
+                slow_rate=slow_rate, factor=factor,
+                namespace="default", max_slow=max_slow,
+            ),),
+        ))
+
+        class Runner:
+            calls = []
+
+            def slow_worker(self, namespace, name, f):
+                self.calls.append((namespace, name, f))
+                return True
+
+        runner = Runner()
+        return api, engine, chaos.WorkerSlower(engine, api, runner), runner
+
+    def test_budget_caps_and_victims_slow_once(self):
+        _, engine, slower, runner = self._fleet(seed=1, max_slow=2)
+        assert slower.tick() == 2
+        assert slower.tick() == 0  # budget spent, victims remembered
+        assert len(runner.calls) == 2
+        events = [e for e in engine.timeline() if e[0] == chaos.SLOW_WORKER]
+        assert len(events) == 2
+        assert all(detail == "factor=2.0" for _, _, detail in events)
+        assert engine.pod_slowdowns_total.value() == 2
+
+    def test_same_seed_same_victims(self):
+        _, engine_a, slower_a, _ = self._fleet(seed=7, slow_rate=0.5)
+        _, engine_b, slower_b, _ = self._fleet(seed=7, slow_rate=0.5)
+        slower_a.tick()
+        slower_b.tick()
+        assert engine_a.timeline() == engine_b.timeline()
+        assert engine_a.timeline()  # the seed does slow someone
+
+    def test_only_running_worker_pods_are_candidates(self):
+        api, _, slower, runner = self._fleet(seed=1)
+        for pod in api.list("pods"):
+            pod["status"] = {"phase": "Pending"}
+            api.update_status("pods", pod)
+        api.create("pods", worker_pod(9, job="j2", role="launcher"))
+        assert slower.tick() == 0
+        assert runner.calls == []
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            chaos.SlowWorkerChaos(slow_rate=0.5, factor=0.5)  # speed-up
+        with pytest.raises(ValueError):
+            chaos.SlowWorkerChaos(slow_rate=1.5)
+
+
+# ---------------------------------------------------------------------------
+# Controller integration: the Straggling condition
+# ---------------------------------------------------------------------------
+
+
+class TestControllerStragglingCondition:
+    def _emit(self, f, job, window, p50s):
+        for i, p50 in enumerate(p50s):
+            pod = f.api.get("pods", "default", f"{job.name}-worker-{i}")
+            pod["metadata"].setdefault("annotations", {})[
+                constants.STEP_HEARTBEAT_ANNOTATION
+            ] = json.dumps(heartbeat(window, p50), sort_keys=True)
+            f.api.update("pods", pod)
+        f.sync(job)
+
+    def test_condition_set_then_recovered(self):
+        f = Fixture()
+        job = make_synced_job(f)
+        f.set_all_workers_phase(job, "Running")
+        f.sync(job)
+        for window in range(3):
+            self._emit(f, job, window, [100.0, 100.0, 100.0, 200.0])
+        job = f.get_job()
+        assert st.has_condition(job.status, JOB_STRAGGLING)
+        cond = next(
+            c for c in job.status.conditions if c.type == JOB_STRAGGLING
+        )
+        assert cond.reason == st.TPUJOB_STRAGGLING_REASON
+        assert "worker(s) 3" in cond.message
+        reasons = [r for _, r in f.events()]
+        assert reasons.count(st.TPUJOB_STRAGGLING_REASON) == 1
+
+        # One healthy window clears the verdict; the condition flips to
+        # False with the recovery reason and a Normal event.
+        self._emit(f, job, 3, [100.0, 100.0, 100.0, 100.0])
+        job = f.get_job()
+        assert not st.has_condition(job.status, JOB_STRAGGLING)
+        cond = next(
+            c for c in job.status.conditions if c.type == JOB_STRAGGLING
+        )
+        assert cond.status == st.CONDITION_FALSE
+        assert cond.reason == st.TPUJOB_STRAGGLER_RECOVERED_REASON
+        assert st.TPUJOB_STRAGGLER_RECOVERED_REASON in [
+            r for _, r in f.events()
+        ]
+
+    def test_healthy_gang_never_flagged(self):
+        f = Fixture()
+        job = make_synced_job(f)
+        f.set_all_workers_phase(job, "Running")
+        f.sync(job)
+        for window in range(4):
+            self._emit(f, job, window, [100.0, 101.0, 99.0, 102.0])
+        job = f.get_job()
+        assert not any(
+            c.type == JOB_STRAGGLING for c in job.status.conditions
+        )
+
+
+# ---------------------------------------------------------------------------
+# The straggler bench (smoke tier, mirroring test_bench_goodput.py)
+# ---------------------------------------------------------------------------
+
+
+class TestBenchStragglerSmoke:
+    def test_detects_within_budget_with_zero_false_positives(self):
+        result = bench.run_factor(2.0, jobs=4, seed=42, windows=8)
+        assert result["false_positive_jobs"] == 0
+        assert result["detected_jobs"] == result["straggler_jobs"]
+        if result["straggler_jobs"]:
+            assert (
+                result["detection_windows_max"]
+                <= stepstats.DEFAULT_CONSECUTIVE_WINDOWS
+            )
+            assert result["skew_wait_seconds_total"] > 0
+        assert result["skew_wait_only_in_straggler_jobs"] is True
+        assert result["phase_tiling_violations"] == 0
+
+    def test_control_arm_carves_no_skew_wait(self):
+        result = bench.run_factor(1.0, jobs=4, seed=42, windows=6)
+        assert result["false_positive_jobs"] == 0
+        assert result["detected_jobs"] == 0
+        assert result["skew_wait_seconds_total"] == 0.0
+        assert result["phase_seconds"][goodput.PHASE_SKEW_WAIT] == 0.0
+
+    def test_same_seed_bit_identical_document(self):
+        a = bench.build_doc([1.0, 2.0], jobs=3, seed=11, windows=6)
+        b = bench.build_doc([1.0, 2.0], jobs=3, seed=11, windows=6)
+        assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+        bench.check_schema(a)
+
+    def test_schema_check_rejects_violations(self):
+        doc = bench.build_doc([1.0], jobs=2, seed=3, windows=4)
+        bench.check_schema(doc)
+        import copy
+
+        broken = copy.deepcopy(doc)
+        del broken["results"][0]["detection_windows_max"]
+        with pytest.raises(ValueError, match="detection_windows_max"):
+            bench.check_schema(broken)
+
+        broken = copy.deepcopy(doc)
+        broken["results"][0]["phase_seconds"]["coffee_break"] = 1.0
+        with pytest.raises(ValueError, match="vocabulary"):
+            bench.check_schema(broken)
+
+        broken = copy.deepcopy(doc)
+        broken["results"][0]["skew_wait_seconds_total"] = 5.0
+        with pytest.raises(ValueError, match="control arm"):
+            bench.check_schema(broken)
+
+    def test_expected_ratio_ground_truth(self):
+        # One slowed worker of four: median stays healthy, ratio = factor.
+        assert bench._expected_ratio(1, 4, 2.0) == pytest.approx(2.0)
+        # Half the gang slowed: the median itself shifts — max/median
+        # legitimately cannot see the full factor.
+        assert bench._expected_ratio(2, 4, 2.0) == pytest.approx(2.0 / 1.5)
+        assert bench._expected_ratio(0, 4, 2.0) == pytest.approx(1.0)

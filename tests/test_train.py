@@ -210,11 +210,14 @@ class TestPreemption:
             if not f.startswith("--xla_force_host_platform_device_count")
         )
         ckpt = str(tmp_path / "ckpt")
+        telemetry_path = tmp_path / "telemetry.jsonl"
         argv = [
             sys.executable, "-m", "mpi_operator_tpu.cmd.train",
             "--model", "llama-tiny", "--steps", "500", "--warmup", "1",
             "--global-batch", "4", "--seq-len", "32", "--log-every", "0",
             "--checkpoint-dir", ckpt, "--save-every", "1",
+            "--telemetry-path", str(telemetry_path),
+            "--telemetry-every", "100000",
         ]
         repo = str(pathlib.Path(__file__).resolve().parent.parent)
         proc = subprocess.Popen(
@@ -239,6 +242,21 @@ class TestPreemption:
         first = json.loads(out.strip().splitlines()[-1])
         assert first["preempted"] is True
         assert 0 < first["final_step"] < 500
+
+        # The preemption final-emit path: with periodic records priced
+        # out (--telemetry-every 100000), the SIGTERM close() must still
+        # write EXACTLY ONE telemetry record, flagged "final": true, at
+        # the checkpointed step — the killed worker's goodput survives
+        # the process, once.
+        telem = [
+            json.loads(ln)
+            for ln in telemetry_path.read_text().strip().splitlines()
+            if json.loads(ln).get("event") == "train_telemetry"
+        ]
+        finals = [r for r in telem if r.get("final")]
+        assert len(finals) == 1 and len(telem) == 1
+        assert finals[0]["step"] == first["final_step"]
+        assert 0.0 < finals[0]["goodput"] <= 1.0
 
         # Resume: absolute --steps means only the remainder runs.
         target = first["final_step"] + 2
