@@ -10,7 +10,7 @@ PYTHON ?= python3
 .PHONY: all native manifests verify-manifests lint analyze image \
         test-kernel test-kernel-smoke test-kernel-deep test-operator \
         test test-unit test-integration test-e2e bench-goodput \
-        bench-straggler ci clean
+        bench-straggler bench-memory bench-all ci clean
 
 all: native manifests
 
@@ -33,7 +33,7 @@ verify-manifests:
 # sandbox has neither and zero egress — docs/round4-notes.md logs the
 # attempt); the homegrown tier is the floor everywhere.
 lint: verify-manifests
-	$(PYTHON) -W error::SyntaxWarning -m compileall -q -f mpi_operator_tpu sdk hack tests bench.py bench_controlplane.py bench_goodput.py bench_straggler.py __graft_entry__.py
+	$(PYTHON) -W error::SyntaxWarning -m compileall -q -f mpi_operator_tpu sdk hack tests bench.py bench_controlplane.py bench_goodput.py bench_straggler.py bench_memory.py __graft_entry__.py
 	$(PYTHON) hack/lint.py
 	@if $(PYTHON) -c 'import ruff' 2>/dev/null; then \
 	    $(PYTHON) -m ruff check mpi_operator_tpu sdk hack tests; \
@@ -122,7 +122,22 @@ bench-goodput:
 bench-straggler:
 	$(PYTHON) bench_straggler.py --jobs 8 --seed 42 --out BENCH_STRAGGLER.json
 
-ci: lint analyze native test bench-goodput bench-straggler
+# Seeded device-memory pressure smoke (bench_memory.py): leak-free
+# control arm plus a 480 MiB/window MemoryLeak arm on the simulated
+# clock; gates detection lead (>= the pressure horizon before injected
+# exhaustion) and zero false positives on either arm.
+bench-memory:
+	$(PYTHON) bench_memory.py --jobs 8 --seed 42 --out BENCH_MEMORY.json
+
+# Every schema-gated bench family, sequentially (the control-plane
+# churn bench has no standing smoke target — run it scaled down here).
+bench-all:
+	$(PYTHON) bench_controlplane.py --jobs 200 --seed 42 --out BENCH_CONTROLPLANE.json
+	$(MAKE) bench-goodput
+	$(MAKE) bench-straggler
+	$(MAKE) bench-memory
+
+ci: lint analyze native test bench-goodput bench-straggler bench-memory
 
 clean:
 	$(MAKE) -C native clean

@@ -9,7 +9,8 @@ keeps its historic ``check_file`` API and flake8-style messages.
 Families:
 
 - TPU001–TPU005 — style tier (legacy aliases F401/B006/E722/F541/F811)
-- TPU101–TPU110 — Prometheus metric naming and required families
+- TPU101–TPU113 — Prometheus metric naming, required families,
+  and sole-writer metric prefixes
 - TPU201–TPU207 — control-plane hygiene (logging, sleep, swallowed
   exceptions, profiling phase vocabulary)
 - TPU301–TPU303 — sole-writer invariants (``runPolicy.suspend``,
@@ -238,7 +239,7 @@ rule("TPU005", "redefinition",
 
 
 # ----------------------------------------------------------------------
-# TPU101–TPU110: Prometheus metric conventions
+# TPU101–TPU113: Prometheus metric conventions
 # ----------------------------------------------------------------------
 
 _METRIC_CTORS = ("new_counter", "new_gauge", "new_histogram")
@@ -398,6 +399,10 @@ _REQUIRED_FAMILIES = [
         "tpu_operator_job_step_skew",
         "tpu_operator_job_stragglers",
     }),
+    ("mpi_operator_tpu/utils/devstats.py", {
+        "tpu_operator_job_hbm_peak_bytes",
+        "tpu_operator_job_hbm_headroom_ratio",
+    }),
 ]
 
 
@@ -462,6 +467,29 @@ def check_stepstats_sole_writer(repo: RepoView) -> Iterable[Finding]:
                 sf.rel, line, "TPU112",
                 f"{kind}({name!r}): step-skew metric prefixes are "
                 f"reserved for {_STEPSTATS_OWNER}",
+            )
+
+
+# The device-memory families are the same kind of cross-worker join:
+# a second writer would split the watermark/headroom series across
+# owners and decouple them from the MemoryPressure verdicts they
+# explain.
+_DEVSTATS_PREFIXES = ("tpu_operator_job_hbm",)
+_DEVSTATS_OWNER = "mpi_operator_tpu/utils/devstats.py"
+
+
+@rule("TPU113", "devstats-metric-sole-writer",
+      "The tpu_operator_job_hbm* metric prefixes are reserved for "
+      "utils/devstats.py, the device-memory observatory.")
+def check_devstats_sole_writer(repo: RepoView) -> Iterable[Finding]:
+    for sf, line, kind, name, _ in _metric_registrations(repo):
+        if not name.startswith(_DEVSTATS_PREFIXES):
+            continue
+        if sf.rel != _DEVSTATS_OWNER:
+            yield Finding(
+                sf.rel, line, "TPU113",
+                f"{kind}({name!r}): device-memory metric prefixes are "
+                f"reserved for {_DEVSTATS_OWNER}",
             )
 
 

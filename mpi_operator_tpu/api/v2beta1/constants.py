@@ -54,6 +54,13 @@ ENV_SLICE_ID = "TPUJOB_SLICE_ID"
 # host without touching the optimization math.  Unset/1.0 = no-op.
 ENV_STEP_SLOWDOWN = "TPUJOB_CHAOS_STEP_SLOWDOWN"
 
+# Chaos-injected per-window HBM leak (chaos MemoryLeak fault →
+# LocalPodRunner child env → utils/devstats.py sampler): the victim's
+# *reported* bytes-in-use grows by this many bytes every telemetry
+# window, driving the real MemoryPressure detector path without
+# allocating anything.  Unset/0 = no-op.
+ENV_MEM_LEAK_BYTES = "TPU_MEM_LEAK_BYTES"
+
 # Cross-process trace propagation (W3C traceparent analog): the controller
 # stamps the reconcile's (trace id, span id) into every pod it builds, and
 # launcher/train adopt it on startup, so operator, launcher, and worker
@@ -90,6 +97,13 @@ WORLD_SIZE_ANNOTATION = "tpujob.kubeflow.org/world-size"
 # transport the step-skew observatory (utils/stepstats.py) consumes via
 # the ordinary pod informer watch.  Value: one JSON object.
 STEP_HEARTBEAT_ANNOTATION = "tpujob.kubeflow.org/step-heartbeat"
+
+# Per-worker device-memory sample (utils/devstats.py window records),
+# patched onto the worker's own Pod by the kubelet sim exactly like the
+# step heartbeat above — the transport the device-memory observatory
+# (utils/devstats.MemoryMatrix) consumes via the pod informer watch.
+# Value: one JSON object.
+DEVICE_MEMORY_ANNOTATION = "tpujob.kubeflow.org/device-memory"
 
 # ConfigMap keys (hostfile/discover_hosts.sh analogs,
 # mpi_job_controller.go:1106-1145).

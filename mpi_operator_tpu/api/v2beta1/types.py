@@ -68,6 +68,10 @@ JOB_QUEUE_NOT_FOUND = "QueueNotFound"
 # gang has a detected straggler, flipped False on recovery.  Orthogonal
 # to the lifecycle conditions — a Straggling job is still Running.
 JOB_STRAGGLING = "Straggling"
+# Device-memory observatory verdict (utils/devstats.py): True while the
+# fleet HBM watermark trend projects exhaustion within the pressure
+# horizon, flipped False on recovery.  Same orthogonality as Straggling.
+JOB_MEMORY_PRESSURE = "MemoryPressure"
 
 # podFailurePolicy actions (batch/v1 PodFailurePolicyAction analog, with
 # ``Restart`` standing in for batch's ``Count`` — the TPU operator

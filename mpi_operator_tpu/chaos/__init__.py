@@ -10,6 +10,7 @@ from its seed.  See docs/failure-handling.md for usage.
 from .apiserver import ChaoticAPIServer, ChaoticWatch
 from .engine import (
     CONFLICT,
+    MEM_LEAK,
     NODE_DEATH,
     POD_KILL,
     SERVER_ERROR,
@@ -21,11 +22,12 @@ from .engine import (
     ChaosEngine,
     ChaosEvent,
 )
-from .podchaos import PodKiller, WorkerSlower
+from .podchaos import LeakInjector, PodKiller, WorkerSlower
 from .policy import (
     READ_VERBS,
     WRITE_VERBS,
     ChaosPolicy,
+    MemoryLeakChaos,
     PodChaos,
     SlowWorkerChaos,
     VerbFaults,
@@ -34,6 +36,7 @@ from .policy import (
 
 __all__ = [
     "CONFLICT",
+    "MEM_LEAK",
     "NODE_DEATH",
     "POD_KILL",
     "READ_VERBS",
@@ -49,6 +52,8 @@ __all__ = [
     "ChaosPolicy",
     "ChaoticAPIServer",
     "ChaoticWatch",
+    "LeakInjector",
+    "MemoryLeakChaos",
     "PodChaos",
     "PodKiller",
     "SlowWorkerChaos",

@@ -27,7 +27,7 @@ from ..runtime.apiserver import (
 )
 from ..runtime import locktrace
 from ..utils.metrics import Registry, new_counter
-from .policy import ChaosPolicy, PodChaos, SlowWorkerChaos
+from .policy import ChaosPolicy, MemoryLeakChaos, PodChaos, SlowWorkerChaos
 
 # Fault kinds (event-log / metric label vocabulary).
 CONFLICT = "conflict"
@@ -39,6 +39,7 @@ WATCH_GONE = "watch_gone"
 POD_KILL = "pod_kill"
 NODE_DEATH = "node_death"
 SLOW_WORKER = "slow_worker"
+MEM_LEAK = "mem_leak"
 
 
 @dataclass(frozen=True)
@@ -64,6 +65,7 @@ class ChaosEngine:
         self._events: list[ChaosEvent] = []
         self._kill_counts: dict[int, int] = {}
         self._slow_counts: dict[int, int] = {}
+        self._leak_counts: dict[int, int] = {}
         self.faults_total = new_counter(
             "tpu_operator_chaos_faults_injected_total",
             "Faults injected by the chaos engine, by kind.",
@@ -79,6 +81,12 @@ class ChaosEngine:
         self.pod_slowdowns_total = new_counter(
             "tpu_operator_chaos_pod_slowdowns_total",
             "Workers degraded by the chaos engine (SlowWorker faults).",
+            registry=registry,
+        )
+        self.pod_leaks_total = new_counter(
+            "tpu_operator_chaos_pod_leaks_total",
+            "Workers given an injected HBM leak by the chaos engine "
+            "(MemoryLeak faults).",
             registry=registry,
         )
 
@@ -209,3 +217,36 @@ class ChaosEngine:
             )
         self.record(SLOW_WORKER, f"pod {key}", f"factor={factor}")
         self.pod_slowdowns_total.inc(1.0)
+
+    # -- leaking workers -------------------------------------------------
+
+    def leak_fault(
+        self, policy_index: int, policy: MemoryLeakChaos
+    ) -> bool:
+        """Decide one (policy, pod, tick)'s fate: give the worker an
+        injected HBM leak or not.  One draw per decision (the
+        determinism contract); a landed leak must be reported via
+        confirm_leak so the max_leak budget counts only victims that
+        actually started leaking."""
+        if policy.leak_rate <= 0.0:
+            return False
+        if policy.max_leak:
+            with self._lock:
+                if (
+                    self._leak_counts.get(policy_index, 0)
+                    >= policy.max_leak
+                ):
+                    return False
+        return self.roll() < policy.leak_rate
+
+    def confirm_leak(
+        self, policy_index: int, key: str, bytes_per_window: int
+    ) -> None:
+        with self._lock:
+            self._leak_counts[policy_index] = (
+                self._leak_counts.get(policy_index, 0) + 1
+            )
+        self.record(
+            MEM_LEAK, f"pod {key}", f"bytes_per_window={bytes_per_window}"
+        )
+        self.pod_leaks_total.inc(1.0)

@@ -12,11 +12,34 @@ from __future__ import annotations
 
 import threading
 
-from ..api.v2beta1.constants import JOB_ROLE_LABEL
+from ..api.v2beta1.constants import JOB_NAME_LABEL, JOB_ROLE_LABEL
 from ..runtime.apiserver import InMemoryAPIServer
-from .engine import NODE_DEATH, POD_KILL, ChaosEngine
+from .engine import MEM_LEAK, NODE_DEATH, POD_KILL, SLOW_WORKER, ChaosEngine
 
-__all__ = ["PodKiller", "WorkerSlower"]
+__all__ = ["LeakInjector", "PodKiller", "WorkerSlower"]
+
+
+def _record_fault(
+    recorder, pod_meta: dict, kind: str, detail: str
+) -> None:
+    """Land a chaos fault on the victim job's flight-recorder timeline
+    (kinds ``slow_worker``/``mem_leak``), so a postmortem shows the
+    injection alongside the conditions it provoked.  No-op without a
+    recorder or when the pod carries no job label."""
+    if recorder is None:
+        return
+    labels = pod_meta.get("labels") or {}
+    job = labels.get(JOB_NAME_LABEL)
+    if not job:
+        return
+    recorder.record(
+        pod_meta.get("namespace", ""),
+        job,
+        kind,
+        reason="ChaosInjected",
+        message=f"pod {pod_meta.get('name', '')}: {detail}",
+        pod=pod_meta.get("name", ""),
+    )
 
 
 class PodKiller:
@@ -94,12 +117,22 @@ class WorkerSlower:
     are skipped — a straggler stays one straggler, not a compounding
     slowdown.  Same pacing contract as PodKiller: a thread in live
     soaks, explicit ``tick()`` calls in deterministic replays.
+
+    With a flight recorder wired, every landed slowdown also lands on
+    the victim job's timeline as a ``slow_worker`` entry.
     """
 
-    def __init__(self, engine: ChaosEngine, api: InMemoryAPIServer, runner):
+    def __init__(
+        self,
+        engine: ChaosEngine,
+        api: InMemoryAPIServer,
+        runner,
+        flight_recorder=None,
+    ):
         self._engine = engine
         self._api = getattr(api, "inner", api)
         self._runner = runner
+        self._recorder = flight_recorder
         self._slowed: set[tuple[str, str]] = set()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -129,6 +162,10 @@ class WorkerSlower:
                     self._engine.confirm_slow(
                         index, f"{key[0]}/{key[1]}", policy.factor
                     )
+                    _record_fault(
+                        self._recorder, meta, SLOW_WORKER,
+                        f"slowed by factor={policy.factor}",
+                    )
                     slowed += 1
         return slowed
 
@@ -141,6 +178,93 @@ class WorkerSlower:
         self._thread = threading.Thread(
             target=self._loop, args=(interval,), daemon=True,
             name="chaos-workerslower",
+        )
+        self._thread.start()
+
+    def _loop(self, interval: float) -> None:
+        while not self._stop.is_set():
+            self.tick()
+            self._stop.wait(interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class LeakInjector:
+    """MemoryLeak chaos: each tick gives every matching running worker
+    one seeded draw deciding whether its reported HBM starts growing by
+    the policy's per-window increment (``runner.leak_worker``, which
+    injects TPU_MEM_LEAK_BYTES at the victim's next (re)start).
+    Already-leaking victims are skipped — one leak per victim, not a
+    compounding one.  Same pacing contract as PodKiller: a thread in
+    live soaks, explicit ``tick()`` calls in deterministic replays.
+
+    With a flight recorder wired, every landed leak also lands on the
+    victim job's timeline as a ``mem_leak`` entry.
+    """
+
+    def __init__(
+        self,
+        engine: ChaosEngine,
+        api: InMemoryAPIServer,
+        runner,
+        flight_recorder=None,
+    ):
+        self._engine = engine
+        self._api = getattr(api, "inner", api)
+        self._runner = runner
+        self._recorder = flight_recorder
+        self._leaked: set[tuple[str, str]] = set()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def tick(self) -> int:
+        """One chaos round; returns the number of leaks that landed."""
+        leaked = 0
+        for index, policy in enumerate(self._engine.policy.leak):
+            if policy.leak_rate <= 0.0 or policy.bytes_per_window <= 0:
+                continue
+            pods = self._api.list("pods", policy.namespace or None)
+            for pod in pods:
+                if (pod.get("status") or {}).get("phase") != "Running":
+                    continue
+                meta = pod.get("metadata") or {}
+                labels = meta.get("labels") or {}
+                role = labels.get(JOB_ROLE_LABEL, "")
+                if policy.roles and role not in policy.roles:
+                    continue
+                key = (meta.get("namespace", ""), meta.get("name", ""))
+                if key in self._leaked:
+                    continue
+                if not self._engine.leak_fault(index, policy):
+                    continue
+                if self._runner.leak_worker(
+                    key[0], key[1], policy.bytes_per_window
+                ):
+                    self._leaked.add(key)
+                    self._engine.confirm_leak(
+                        index, f"{key[0]}/{key[1]}",
+                        policy.bytes_per_window,
+                    )
+                    _record_fault(
+                        self._recorder, meta, MEM_LEAK,
+                        f"leaking {policy.bytes_per_window} bytes/window",
+                    )
+                    leaked += 1
+        return leaked
+
+    # -- background pacing (live soaks) ---------------------------------
+
+    def start(self, interval: float = 0.05) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, args=(interval,), daemon=True,
+            name="chaos-leakinjector",
         )
         self._thread.start()
 

@@ -146,6 +146,33 @@ class SlowWorkerChaos:
 
 
 @dataclass(frozen=True)
+class MemoryLeakChaos:
+    """Leaking-worker injection: a matching running worker's *reported*
+    HBM grows by ``bytes_per_window`` every telemetry window.
+
+    Models the slow-burn memory leak the device-memory observatory
+    (utils/devstats.py) exists to catch early — a watermark that climbs
+    for many windows before the OOM killer fires, which pod-phase chaos
+    can never produce.  ``bytes_per_window=0`` is a no-op (useful as the
+    bench's control arm).
+    """
+
+    leak_rate: float = 0.0
+    bytes_per_window: int = 0
+    roles: tuple[str, ...] = (ROLE_WORKER,)
+    namespace: str = ""  # "" = every namespace
+    max_leak: int = 0  # 0 = unlimited
+
+    def __post_init__(self) -> None:
+        _check_rate("leak_rate", self.leak_rate)
+        if self.bytes_per_window < 0:
+            raise ValueError(
+                f"bytes_per_window must be >= 0 (memory un-leaking is "
+                f"not chaos), got {self.bytes_per_window!r}"
+            )
+
+
+@dataclass(frozen=True)
 class ChaosPolicy:
     """One replayable chaos run: seed + the active fault policies."""
 
@@ -154,6 +181,7 @@ class ChaosPolicy:
     watch: Optional[WatchFaults] = None
     pods: tuple[PodChaos, ...] = ()
     slow: tuple[SlowWorkerChaos, ...] = ()
+    leak: tuple[MemoryLeakChaos, ...] = ()
 
     def verb_policy(self, verb: str, resource: str) -> Optional[VerbFaults]:
         """First policy matching (verb, resource); None = no faults."""

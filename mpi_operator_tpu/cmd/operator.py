@@ -38,7 +38,7 @@ from ..runtime import locktrace
 from ..runtime.apiserver import InMemoryAPIServer, NotFoundError
 from ..runtime.leaderelection import LeaderElectionConfig, LeaderElector
 from ..runtime.podrunner import LocalPodRunner
-from ..utils import flightrecorder, goodput, metrics, profiling, stepstats, trace
+from ..utils import devstats, flightrecorder, goodput, metrics, profiling, stepstats, trace
 from ..utils import logging as logutil
 from ..version import version_string
 
@@ -156,28 +156,79 @@ class _MonitoringHandler(BaseHTTPRequestHandler):
     flight_recorder: Optional[flightrecorder.FlightRecorder] = None
     goodput_ledger: Optional[goodput.GoodputLedger] = None
     step_matrix: Optional[stepstats.StepMatrix] = None
+    memory_matrix: Optional[devstats.MemoryMatrix] = None
     profiler: Optional[profiling.PhaseProfiler] = None
     workqueues: tuple = ()
     health_fn = staticmethod(lambda: True)
 
     # The per-job debug leaves this server can dispatch; the unknown-leaf
     # 404 body enumerates them so a typo'd URL is self-diagnosing.
-    KNOWN_JOB_SUBRESOURCES = ("goodput", "steps", "timeline")
+    KNOWN_JOB_SUBRESOURCES = ("goodput", "memory", "steps", "timeline")
+
+    def _debug_jobs_index(self) -> tuple[int, str, bytes]:
+        """The ``/debug/jobs`` index: every job the flight recorder
+        remembers, with the debug subresources that currently have data
+        for it — the entry point that makes the per-job pages
+        discoverable without knowing a job name in advance."""
+        import json
+
+        if self.flight_recorder is None:
+            return 404, "text/plain", b"not found"
+        jobs = []
+        for namespace, name in sorted(self.flight_recorder.jobs()):
+            subresources = ["timeline"]
+            if (
+                self.goodput_ledger is not None
+                and self.goodput_ledger.job_snapshot(namespace, name)
+                is not None
+            ):
+                subresources.append("goodput")
+            if (
+                self.step_matrix is not None
+                and self.step_matrix.job_snapshot(namespace, name)
+                is not None
+            ):
+                subresources.append("steps")
+            if (
+                self.memory_matrix is not None
+                and self.memory_matrix.job_snapshot(namespace, name)
+                is not None
+            ):
+                subresources.append("memory")
+            jobs.append({
+                "namespace": namespace,
+                "name": name,
+                "subresources": sorted(subresources),
+            })
+        body = json.dumps(
+            {"jobs": jobs, "known_subresources": list(
+                self.KNOWN_JOB_SUBRESOURCES
+            )},
+            indent=2, sort_keys=True,
+        ) + "\n"
+        return 200, "application/json", body.encode()
 
     def _debug_jobs_response(self) -> tuple[int, str, bytes]:
         """(status, content-type, body) for the per-job debug pages:
         /debug/jobs/<ns>/<name>/timeline (with ?limit=N / ?kind=K
-        filters; 400 on malformed values) and
+        filters; 400 on malformed values),
         /debug/jobs/<ns>/<name>/goodput (the ledger's phase
-        decomposition), and /debug/jobs/<ns>/<name>/steps (the step-skew
-        matrix).  404 when the page, the backing component, or the job
-        itself is unknown; an unknown *leaf* on a well-formed path gets
-        a JSON body listing the known subresources."""
+        decomposition), /debug/jobs/<ns>/<name>/steps (the step-skew
+        matrix), and /debug/jobs/<ns>/<name>/memory (the device-memory
+        matrix) — plus the bare /debug/jobs index listing recorded jobs.
+        404 when the page, the backing component, or the job itself is
+        unknown; an unknown *leaf* on a well-formed path gets a JSON
+        body listing the known subresources."""
         import json
         from urllib.parse import urlsplit
 
         split = urlsplit(self.path)
         parts = split.path.split("/")
+        if parts[:3] != ["", "debug", "jobs"]:
+            return 404, "text/plain", b"not found"
+        # /debug/jobs or /debug/jobs/ → the index.
+        if len(parts) == 3 or (len(parts) == 4 and parts[3] == ""):
+            return self._debug_jobs_index()
         # ['', 'debug', 'jobs', ns, name, leaf]
         if len(parts) != 6:
             return 404, "text/plain", b"not found"
@@ -213,6 +264,15 @@ class _MonitoringHandler(BaseHTTPRequestHandler):
             return 200, "application/json", (
                 json.dumps(snap, indent=2, sort_keys=True) + "\n"
             ).encode()
+        if leaf == "memory":
+            if self.memory_matrix is None:
+                return 404, "text/plain", b"not found"
+            snap = self.memory_matrix.job_snapshot(namespace, name)
+            if snap is None:
+                return 404, "text/plain", b"not found"
+            return 200, "application/json", (
+                json.dumps(snap, indent=2, sort_keys=True) + "\n"
+            ).encode()
         if self.goodput_ledger is None:
             return 404, "text/plain", b"not found"
         snap = self.goodput_ledger.job_snapshot(namespace, name)
@@ -227,7 +287,9 @@ class _MonitoringHandler(BaseHTTPRequestHandler):
             body = self.registry.expose().encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
-        elif self.path.startswith("/debug/jobs/"):
+        elif self.path.split("?", 1)[0].rstrip("/") == "/debug/jobs" or (
+            self.path.startswith("/debug/jobs/")
+        ):
             status, content_type, body = self._debug_jobs_response()
             self.send_response(status)
             self.send_header("Content-Type", content_type)
@@ -294,6 +356,7 @@ def start_monitoring(port: int, registry: metrics.Registry, health_fn,
                          flightrecorder.FlightRecorder] = None,
                      goodput_ledger: Optional[goodput.GoodputLedger] = None,
                      step_matrix: Optional[stepstats.StepMatrix] = None,
+                     memory_matrix: Optional[devstats.MemoryMatrix] = None,
                      profiler: Optional[profiling.PhaseProfiler] = None,
                      workqueues=()):
     """startMonitoring (main.go:29-40) + healthz server (:192-208) analog,
@@ -301,9 +364,11 @@ def start_monitoring(port: int, registry: metrics.Registry, health_fn,
     ``/debug/jobs/<ns>/<name>/timeline`` flight-recorder endpoint (with
     ``?limit=``/``?kind=`` filters), the goodput pages
     (``/debug/jobs/<ns>/<name>/goodput`` + fleet ``/debug/goodput``),
-    the step-skew matrix (``/debug/jobs/<ns>/<name>/steps``), and the
-    ``/debug/profile`` phase-profile snapshot (``profiler`` plus the
-    ``workqueues`` whose health it reports)."""
+    the step-skew matrix (``/debug/jobs/<ns>/<name>/steps``), the
+    device-memory matrix (``/debug/jobs/<ns>/<name>/memory``), the
+    ``/debug/jobs`` index, and the ``/debug/profile`` phase-profile
+    snapshot (``profiler`` plus the ``workqueues`` whose health it
+    reports)."""
     handler = type(
         "Handler",
         (_MonitoringHandler,),
@@ -314,6 +379,7 @@ def start_monitoring(port: int, registry: metrics.Registry, health_fn,
             "flight_recorder": flight_recorder,
             "goodput_ledger": goodput_ledger,
             "step_matrix": step_matrix,
+            "memory_matrix": memory_matrix,
             "profiler": profiler,
             "workqueues": tuple(workqueues),
             "health_fn": staticmethod(health_fn),
@@ -432,6 +498,9 @@ def run(argv=None) -> int:
     # bounded by the recorder's LRU); built before the ledger so the
     # ledger can carve skew_wait out of productive.
     matrix = stepstats.StepMatrix(recorder, registry=registry)
+    # The device-memory observatory rides the recorder with the same
+    # LRU-bounded pruning contract.
+    mem_matrix = devstats.MemoryMatrix(recorder, registry=registry)
     # The goodput ledger rides the recorder: per-job phase attribution,
     # scrape-time goodput metrics, and the /debug/goodput rollup.
     ledger = goodput.GoodputLedger(
@@ -494,6 +563,7 @@ def run(argv=None) -> int:
         registry=registry,
         flight_recorder=recorder,
         step_matrix=matrix,
+        memory_matrix=mem_matrix,
     )
     # Controller metrics share the exposed registry.
     if runner is not None:
@@ -591,6 +661,7 @@ def run(argv=None) -> int:
             args.monitoring_port, registry, health,
             address=args.monitoring_address, flight_recorder=recorder,
             goodput_ledger=ledger, step_matrix=matrix,
+            memory_matrix=mem_matrix,
             profiler=profiling.profiler_for(registry), workqueues=queues,
         )
         print(

@@ -136,10 +136,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="append the telemetry JSONL here instead of stderr")
     p.add_argument("--heartbeat-every", type=int, default=0,
                    help="emit a step_heartbeat JSONL record (per-window "
-                        "step-wall p50/max, wait share) every N post-warmup "
-                        "steps; the kubelet sim tails these into pod "
-                        "annotations for the step-skew observatory. "
-                        "0 disables")
+                        "step-wall p50/max, wait share) plus a "
+                        "device_memory HBM watermark sample every N "
+                        "post-warmup steps; the kubelet sim tails these "
+                        "into pod annotations for the step-skew and "
+                        "device-memory observatories. 0 disables")
     return p
 
 
@@ -916,6 +917,16 @@ def main(argv=None) -> int:
     # would cost the throughput we are measuring; the deltas still sum to
     # true wall time, and warmup (compile) steps land in the goodput
     # denominator but not the numerator.
+    # Device-memory observatory input: with heartbeats on, each closed
+    # window also emits one HBM watermark sample (device_memory JSONL →
+    # pod annotation → operator memory matrix).  The sampler reads the
+    # chaos leak increment (TPU_MEM_LEAK_BYTES) from its env on its own.
+    devstats_sampler = None
+    if args.heartbeat_every > 0:
+        from ..utils import devstats as devstats_lib
+
+        devstats_sampler = devstats_lib.DeviceMemorySampler().sample
+
     telem = telemetry_lib.TrainingTelemetry(
         tokens_per_step=work.tokens_per_step,
         examples_per_step=work.examples_per_step,
@@ -923,6 +934,7 @@ def main(argv=None) -> int:
         interval=max(args.telemetry_every, 0),
         jsonl_path=args.telemetry_path,
         heartbeat_interval=max(args.heartbeat_every, 0),
+        devstats_sampler=devstats_sampler,
     )
 
     # Chaos SlowWorker fault: the pod runner injects a per-worker step

@@ -39,6 +39,9 @@ TPUJOB_UNSCHEDULABLE_REASON = "Unschedulable"
 # Step-skew observatory (utils/stepstats.py) verdicts.
 TPUJOB_STRAGGLING_REASON = "TPUJobStraggling"
 TPUJOB_STRAGGLER_RECOVERED_REASON = "TPUJobStragglerRecovered"
+# Device-memory observatory (utils/devstats.py) verdicts.
+TPUJOB_MEMORY_PRESSURE_REASON = "TPUJobMemoryPressure"
+TPUJOB_MEMORY_RECOVERED_REASON = "TPUJobMemoryRecovered"
 
 CONDITION_TRUE = "True"
 CONDITION_FALSE = "False"

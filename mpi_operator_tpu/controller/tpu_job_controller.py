@@ -34,6 +34,7 @@ from ..api.v2beta1.types import (
     JOB_POD_FAILURE_POLICY_REASON,
     JOB_RESTARTING,
     JOB_RUNNING,
+    JOB_MEMORY_PRESSURE,
     JOB_SCHEDULED,
     JOB_STRAGGLING,
     JOB_SUCCEEDED,
@@ -59,7 +60,7 @@ from ..runtime.client import KubeClient, SchedulingClient, TPUJobClient
 from ..runtime.informer import EventHandler, InformerFactory, meta_namespace_key, split_key
 from ..runtime.objects import KubeObject
 from ..runtime.workqueue import RateLimitingQueue
-from ..utils import flightrecorder, metrics, profiling, statemetrics, stepstats, trace
+from ..utils import devstats, flightrecorder, metrics, profiling, statemetrics, stepstats, trace
 from ..utils import logging as logutil
 from ..utils.events import (
     EVENT_TYPE_NORMAL,
@@ -128,6 +129,7 @@ class TPUJobController:
         tracer: Optional[trace.Tracer] = None,
         flight_recorder: Optional[flightrecorder.FlightRecorder] = None,
         step_matrix: Optional[stepstats.StepMatrix] = None,
+        memory_matrix: Optional[devstats.MemoryMatrix] = None,
         clock: Callable[[], float] = time.time,
     ):
         self.api = api
@@ -161,6 +163,13 @@ class TPUJobController:
             stepstats.StepMatrix(self.flight_recorder)
             if step_matrix is None
             else step_matrix
+        )
+        # Device-memory observatory: same single-instance contract as
+        # the step matrix above.
+        self.memory_matrix = (
+            devstats.MemoryMatrix(self.flight_recorder)
+            if memory_matrix is None
+            else memory_matrix
         )
         self.jobs_created = metrics.new_counter(
             "tpu_operator_jobs_created_total", "Counts number of TPU jobs created",
@@ -244,14 +253,23 @@ class TPUJobController:
         ):
             informer.add_event_handler(dependent)
         # Heartbeat intake rides the ordinary pod watch: every add/update
-        # folds the pod's step-heartbeat annotation (if any) into the
-        # matrix, and the dependent handler above already enqueues the
-        # owning job, so a fresh straggler verdict reaches
-        # _update_job_status without a dedicated resync path.
+        # folds the pod's step-heartbeat and device-memory annotations
+        # (if any) into the matrices, and the dependent handler above
+        # already enqueues the owning job, so fresh straggler/pressure
+        # verdicts reach _update_job_status without a dedicated resync
+        # path.
         self.pod_informer.add_event_handler(
             EventHandler(
                 on_add=self.step_matrix.observe_pod,
                 on_update=lambda old, new: self.step_matrix.observe_pod(new),
+            )
+        )
+        self.pod_informer.add_event_handler(
+            EventHandler(
+                on_add=self.memory_matrix.observe_pod,
+                on_update=lambda old, new: self.memory_matrix.observe_pod(
+                    new
+                ),
             )
         )
 
@@ -1368,6 +1386,56 @@ class TPUJobController:
                         st.TPUJOB_STRAGGLER_RECOVERED_REASON, msg,
                         status=st.CONDITION_FALSE, now=now,
                         skew_ratio=verdict["skew_ratio"],
+                    )
+
+            # Device-memory verdict (utils/devstats.py): projected HBM
+            # exhaustion within the pressure horizon raises
+            # MemoryPressure; a flattened trend flips it False.  Same
+            # say-nothing contract as the skew verdict when the matrix
+            # has no joined windows yet.
+            mem = self.memory_matrix.pressure_verdict(
+                job.namespace, job.name
+            )
+            if mem is not None:
+                if mem["pressure"]:
+                    projected = mem["projected_windows"]
+                    msg = truncate_message(
+                        f"TPUJob {job.namespace}/{job.name} is under "
+                        f"device-memory pressure: HBM exhaustion "
+                        f"projected in {projected:.1f} window(s) "
+                        f"(headroom {mem['headroom_ratio']:.1%}, worker "
+                        f"{mem['top_worker']} at window {mem['window']})"
+                    )
+                    if not st.has_condition(
+                        job.status, JOB_MEMORY_PRESSURE
+                    ):
+                        self.recorder.event(
+                            job, EVENT_TYPE_WARNING,
+                            st.TPUJOB_MEMORY_PRESSURE_REASON, msg,
+                        )
+                    self._set_condition(
+                        job, JOB_MEMORY_PRESSURE,
+                        st.TPUJOB_MEMORY_PRESSURE_REASON, msg, now=now,
+                        projected_windows=mem["projected_windows"],
+                        headroom_ratio=mem["headroom_ratio"],
+                        top_worker=mem["top_worker"],
+                    )
+                elif st.has_condition(job.status, JOB_MEMORY_PRESSURE):
+                    msg = (
+                        f"TPUJob {job.namespace}/{job.name} device-memory "
+                        f"pressure recovered: headroom "
+                        f"{mem['headroom_ratio']:.1%} at window "
+                        f"{mem['window']}"
+                    )
+                    self.recorder.event(
+                        job, EVENT_TYPE_NORMAL,
+                        st.TPUJOB_MEMORY_RECOVERED_REASON, msg,
+                    )
+                    self._set_condition(
+                        job, JOB_MEMORY_PRESSURE,
+                        st.TPUJOB_MEMORY_RECOVERED_REASON, msg,
+                        status=st.CONDITION_FALSE, now=now,
+                        headroom_ratio=mem["headroom_ratio"],
                     )
 
         if job.status.to_dict() != old_status:

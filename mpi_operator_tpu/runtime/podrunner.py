@@ -14,10 +14,12 @@ operator wires up on a cluster:
   DNS) and the JAX platform pinned to CPU for hermeticity;
 - ``restartPolicy: OnFailure`` restarts the process (bounded);
 - pod logs are tailed LIVE (a reader thread per process, not a read at
-  reap), and ``step_heartbeat`` JSONL lines the trainer emits are
-  patched onto the pod as the step-heartbeat annotation — the kubelet
-  half of the step-skew observatory (the pod informer watch carries the
-  patch to utils/stepstats.py with no new transport);
+  reap), and ``step_heartbeat``/``device_memory`` JSONL lines the
+  trainer emits are patched onto the pod as the step-heartbeat and
+  device-memory annotations — the kubelet half of the step-skew and
+  device-memory observatories (the pod informer watch carries the
+  patches to utils/stepstats.py and utils/devstats.py with no new
+  transport);
 - batch/v1 Jobs get a pod created from their template and their status
   mirrored to Complete/Failed with backoffLimit retries — the part of the
   reference flow that the kube Job controller owns
@@ -98,6 +100,11 @@ class LocalPodRunner:
         # live process takes effect at its next (re)start — the runner
         # cannot retroactively slow a running subprocess.
         self._slow: dict[tuple[str, str], float] = {}
+        # Chaos MemoryLeak registrations: pod key -> bytes per window,
+        # injected into the child env (ENV_MEM_LEAK_BYTES) so the
+        # worker's devstats sampler inflates its reported HBM; same
+        # next-(re)start semantics as _slow.
+        self._leak: dict[tuple[str, str], int] = {}
         self._job_pods: dict[tuple[str, str], int] = {}  # job -> failures so far
         self._lock = locktrace.rlock("podrunner")
         self._stop = threading.Event()
@@ -184,6 +191,9 @@ class LocalPodRunner:
         factor = self._slow.get(self._event_key(pod))
         if factor is not None and factor > 1.0:
             env[constants.ENV_STEP_SLOWDOWN] = str(factor)
+        leak = self._leak.get(self._event_key(pod))
+        if leak is not None and leak > 0:
+            env[constants.ENV_MEM_LEAK_BYTES] = str(leak)
         container = (pod["spec"].get("containers") or [{}])[0]
         for item in container.get("env") or []:
             value = str(item.get("value", ""))
@@ -289,27 +299,32 @@ class LocalPodRunner:
                 record = json.loads(stripped)
             except ValueError:
                 continue
-            if (
-                isinstance(record, dict)
-                and record.get("event") == "step_heartbeat"
-            ):
-                self._publish_heartbeat(key, record)
+            if not isinstance(record, dict):
+                continue
+            event = record.get("event")
+            if event == "step_heartbeat":
+                self._publish_annotation(
+                    key, constants.STEP_HEARTBEAT_ANNOTATION, record
+                )
+            elif event == "device_memory":
+                self._publish_annotation(
+                    key, constants.DEVICE_MEMORY_ANNOTATION, record
+                )
 
-    def _publish_heartbeat(
-        self, key: tuple[str, str], record: dict
+    def _publish_annotation(
+        self, key: tuple[str, str], annotation: str, record: dict
     ) -> None:
-        """Patch the heartbeat onto the pod's step-heartbeat annotation
-        (get+mutate+update with conflict retry — the memory apiserver has
-        no patch verb).  The resulting MODIFIED watch event is how the
-        controller's step matrix learns about the window."""
+        """Patch a telemetry record onto one of the pod's observatory
+        annotations — step heartbeats and device-memory samples share
+        this bridge (get+mutate+update with conflict retry — the memory
+        apiserver has no patch verb).  The resulting MODIFIED watch event
+        is how the controller-side matrices learn about the window."""
 
         def apply():
             pod = self.api.get("pods", key[0], key[1])
             meta = pod.setdefault("metadata", {})
             annotations = dict(meta.get("annotations") or {})
-            annotations[constants.STEP_HEARTBEAT_ANNOTATION] = json.dumps(
-                record, sort_keys=True
-            )
+            annotations[annotation] = json.dumps(record, sort_keys=True)
             meta["annotations"] = annotations
             return self.api.update("pods", pod)
 
@@ -320,11 +335,11 @@ class LocalPodRunner:
         except NotFoundError:
             pass  # pod deleted mid-run; nothing to annotate
         except ConflictError:
-            pass  # next window's heartbeat will carry fresher numbers
+            pass  # next window's record will carry fresher numbers
         except Exception:
             self.log.debug(
-                "heartbeat annotation patch failed for %s/%s",
-                key[0], key[1],
+                "annotation patch %s failed for %s/%s",
+                annotation, key[0], key[1],
             )
 
     def _kill(self, key: tuple[str, str]) -> None:
@@ -409,6 +424,27 @@ class LocalPodRunner:
         runner does not know."""
         if factor < 1.0:
             return False
+        return self._register_chaos(self._slow, namespace, name, factor)
+
+    def leak_worker(
+        self, namespace: str, name: str, bytes_per_window: int
+    ) -> bool:
+        """Chaos hook: mark the pod's worker as leaking HBM by
+        ``bytes_per_window``.  The increment reaches the devstats
+        sampler via ENV_MEM_LEAK_BYTES at the pod's next (re)start —
+        same semantics as slow_worker.  Returns False for pods this
+        runner does not know."""
+        if bytes_per_window <= 0:
+            return False
+        return self._register_chaos(
+            self._leak, namespace, name, int(bytes_per_window)
+        )
+
+    def _register_chaos(
+        self, table: dict, namespace: str, name: str, value
+    ) -> bool:
+        """Shared registration for next-(re)start chaos env injection:
+        the pod must be running here or at least exist in the apiserver."""
         key = (namespace, name)
         with self._lock:
             if key not in self._pods:
@@ -416,7 +452,7 @@ class LocalPodRunner:
                     self.api.get("pods", namespace, name)
                 except NotFoundError:
                     return False
-            self._slow[key] = factor
+            table[key] = value
         return True
 
     def fail_node(self, namespace: str, name: str) -> bool:

@@ -27,12 +27,20 @@ from typing import Optional
 DEFAULT_CAPACITY_PER_JOB = 256
 DEFAULT_MAX_JOBS = 256
 
-# Entry kinds (the four subscribed sources).
+# Entry kinds (the subscribed sources).
 CONDITION = "condition"
 EVENT = "event"
 SCHEDULING = "scheduling"
 POD = "pod"
-KINDS = (CONDITION, EVENT, SCHEDULING, POD)
+# Chaos faults targeting a job's workers (chaos/podchaos.py injectors)
+# land on the victim job's timeline under the engine's fault-kind
+# vocabulary, and the device-memory observatory (utils/devstats.py)
+# freezes its last joined snapshot as a MEMORY entry when a pod dies
+# with the OOM exit code.
+SLOW_WORKER = "slow_worker"
+MEM_LEAK = "mem_leak"
+MEMORY = "memory"
+KINDS = (CONDITION, EVENT, SCHEDULING, POD, SLOW_WORKER, MEM_LEAK, MEMORY)
 
 
 class FlightRecorder:

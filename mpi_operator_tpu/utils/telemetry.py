@@ -20,7 +20,17 @@ for ``cmd.train``:
   (step-wall p50/max, barrier/collective-wait share) is emitted every N
   post-warmup steps and handed to an optional publisher — the raw input
   of the operator-side step-skew observatory (utils/stepstats.py), which
-  joins heartbeats across workers to find stragglers.
+  joins heartbeats across workers to find stragglers;
+- with a ``devstats_sampler`` wired (utils/devstats.DeviceMemorySampler),
+  each closed heartbeat window also emits one ``device_memory`` record
+  (HBM in-use/peak/limit watermarks) — the raw input of the operator-side
+  device-memory observatory (utils/devstats.MemoryMatrix).
+
+The SIGTERM contract — emit ``final: true`` exactly once per process,
+across the telemetry record, the heartbeat flush, and the devstats
+sample — is owned by one shared ``FinalOnce`` latch, so a double
+delivery of SIGTERM (kubelet retry, supervisor impatience) can never
+double-emit the final records.
 
 Step durations are dispatch-to-dispatch wall times: JAX dispatch is async,
 so an individual step's number can lag its true device time, but the
@@ -48,6 +58,37 @@ STEP_BUCKETS = (
 )
 
 
+class FinalOnce:
+    """One-shot latch for the "emit ``final: true`` exactly once" SIGTERM
+    contract.
+
+    Every shutdown path that wants to stamp a final record claims the
+    latch first; only the first claim wins.  Shared by the final
+    telemetry record, the final heartbeat flush, and the final devstats
+    sample, so the guard lives in one place instead of being duplicated
+    per record family.
+    """
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._claimed = False
+
+    def claim(self) -> bool:
+        """True exactly once; every later claim returns False."""
+        with self._lock:
+            if self._claimed:
+                return False
+            self._claimed = True
+            return True
+
+    @property
+    def claimed(self) -> bool:
+        with self._lock:
+            return self._claimed
+
+
 class TrainingTelemetry:
     """Accumulates per-step timings and derives throughput/goodput.
 
@@ -69,6 +110,7 @@ class TrainingTelemetry:
         clock: Callable[[], float] = time.perf_counter,
         heartbeat_interval: int = 0,
         heartbeat_publisher: Optional[Callable[[dict], None]] = None,
+        devstats_sampler: Optional[Callable[[int], Optional[dict]]] = None,
     ):
         self.tokens_per_step = tokens_per_step
         self.examples_per_step = examples_per_step
@@ -93,6 +135,11 @@ class TrainingTelemetry:
         self._hb_durations: list[float] = []
         self._hb_wait_s = 0.0
         self._hb_window = 0
+
+        # Device-memory observatory input: one HBM watermark sample per
+        # closed heartbeat window (utils/devstats.DeviceMemorySampler).
+        self.devstats_sampler = devstats_sampler
+        self._final_once = FinalOnce()
 
         registry = registry or metrics.DEFAULT_REGISTRY
         self.registry = registry
@@ -220,6 +267,31 @@ class TrainingTelemetry:
                 # A broken publisher (apiserver away, annotation conflict
                 # storm) must never take the training loop down with it.
                 pass
+        # The device-memory observatory samples at the same cadence: one
+        # HBM watermark record per closed heartbeat window.
+        self.emit_device_memory(rec["window"])
+        return rec
+
+    def emit_device_memory(
+        self, window: int, *, final: bool = False
+    ) -> Optional[dict]:
+        """Emit one ``device_memory`` JSONL record for ``window`` via the
+        wired sampler (None without one).  Sampler breakage is swallowed:
+        memory telemetry must never take the training loop down."""
+        if self.devstats_sampler is None:
+            return None
+        try:
+            rec = self.devstats_sampler(window)
+        except Exception:
+            return None
+        if not rec:
+            return None
+        rec = self._stamp_identity(dict(rec))
+        if final:
+            rec["final"] = True
+        emit_json(
+            rec, stream=self._file if self._file is not None else self._stream
+        )
         return rec
 
     def record_checkpoint(self, duration_s: float) -> None:
@@ -292,12 +364,22 @@ class TrainingTelemetry:
         periodic records are enabled and a step landed since the last
         one; ``final=True`` (the preemption/SIGTERM path) always emits,
         so a killed worker's partial goodput and step count are never
-        lost with the process."""
+        lost with the process.
+
+        The FinalOnce latch makes ``final`` idempotent: a second SIGTERM
+        delivery degrades to a plain close instead of double-emitting the
+        final records."""
+        if final:
+            final = self._final_once.claim()
         rec = None
         if self.heartbeat_interval and self._hb_durations:
             # Flush the partial window: a preempted worker's last steps
             # still reach the operator-side step matrix.
             self.emit_heartbeat(step)
+        if final:
+            # The dying worker's last HBM watermark: the OOM-forensics
+            # snapshot the operator freezes must be as fresh as possible.
+            self.emit_device_memory(self._hb_window, final=True)
         if final or (self.interval and step > self._last_emit_step):
             rec = self.emit(step, final=final)
         if self._file is not None:

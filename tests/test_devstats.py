@@ -1,0 +1,642 @@
+"""Device-memory observatory tests (utils/devstats.py + friends).
+
+The MemoryMatrix's contract, exercised layer by layer: the worker-side
+sampler is deterministic over the fake backend and inflates honestly
+under an injected leak, samples join per window with stepstats' roster
+semantics (a lone worker's memory still counts — unlike skew, one
+member is meaningful), the watermark-trend projector raises
+``MemoryPressure`` only on a rising limit-bearing trend and recovers
+symmetrically, an OOM-killed pod freezes its last joined snapshot into
+the flight recorder, the recorder's LRU transitively bounds the matrix
+and its gauge series, the MemoryLeak chaos surface is
+seeded-deterministic and budgeted, the controller surfaces/clears the
+``MemoryPressure`` condition, and the memory bench reproduces
+bit-identically from its seed.
+"""
+
+import json
+
+import pytest
+
+import bench_memory as bench
+from mpi_operator_tpu import chaos
+from mpi_operator_tpu.api.v2beta1 import constants
+from mpi_operator_tpu.api.v2beta1.types import JOB_MEMORY_PRESSURE
+from mpi_operator_tpu.controller import status as st
+from mpi_operator_tpu.runtime.apiserver import InMemoryAPIServer
+from mpi_operator_tpu.utils import devstats, flightrecorder, metrics
+
+from tests.test_controller import Fixture, make_synced_job
+
+LIMIT = 1000
+
+
+def memsample(window, in_use, peak=None, limit=LIMIT, **extra):
+    rec = {
+        "event": "device_memory",
+        "window": window,
+        "hbm_bytes_in_use": in_use,
+        "hbm_peak_bytes": in_use if peak is None else peak,
+        "hbm_limit_bytes": limit,
+        "compile_cache_entries": 0,
+    }
+    rec.update(extra)
+    return rec
+
+
+def worker_pod(index, job="j1", namespace="default", phase="Running",
+               record=None, role=constants.ROLE_WORKER, status=None):
+    pod = {
+        "metadata": {
+            "name": f"{job}-worker-{index}",
+            "namespace": namespace,
+            "labels": {
+                constants.JOB_NAME_LABEL: job,
+                constants.JOB_ROLE_LABEL: role,
+                constants.REPLICA_INDEX_LABEL: str(index),
+            },
+        },
+        "status": {"phase": phase},
+    }
+    if status:
+        pod["status"].update(status)
+    if record is not None:
+        pod["metadata"]["annotations"] = {
+            constants.DEVICE_MEMORY_ANNOTATION: json.dumps(
+                record, sort_keys=True
+            )
+        }
+    return pod
+
+
+def oom_status():
+    return {
+        "containerStatuses": [
+            {"state": {"terminated": {"exitCode": 137,
+                                      "reason": "OOMKilled"}}}
+        ]
+    }
+
+
+def make_matrix(registry=None, **kw):
+    fr = flightrecorder.FlightRecorder(clock=lambda: 0.0)
+    matrix = devstats.MemoryMatrix(
+        fr, registry=registry, clock=lambda: 0.0, **kw
+    )
+    return matrix, fr
+
+
+def register_roster(matrix, workers, job="j1"):
+    for i in range(workers):
+        matrix.observe_pod(worker_pod(i, job=job))
+
+
+def emit_window(matrix, window, in_uses, job="j1", limit=LIMIT):
+    """One joined window: worker i reports in_uses[i] bytes."""
+    for i, in_use in enumerate(in_uses):
+        matrix.observe_pod(
+            worker_pod(i, job=job, record=memsample(window, in_use,
+                                                    limit=limit))
+        )
+
+
+# ---------------------------------------------------------------------------
+# Worker side: fake backend + sampler
+# ---------------------------------------------------------------------------
+
+
+class TestDeviceMemorySampler:
+    def test_fake_backend_is_deterministic_and_validated(self):
+        b = devstats.FakeMemoryBackend(ripple_bytes=100)
+        assert b.stats(0) == b.stats(4)  # period-4 ripple
+        assert b.stats(1)["bytes_in_use"] == devstats.DEFAULT_FAKE_BASE_BYTES
+        assert b.stats(0)["bytes_limit"] == devstats.DEFAULT_FAKE_LIMIT_BYTES
+        with pytest.raises(ValueError, match="limit_bytes"):
+            devstats.FakeMemoryBackend(limit_bytes=0)
+        with pytest.raises(ValueError, match="base_bytes"):
+            devstats.FakeMemoryBackend(limit_bytes=10, base_bytes=11)
+
+    def test_sample_schema_and_running_peak(self):
+        backend = devstats.FakeMemoryBackend(ripple_bytes=1000)
+        s = devstats.DeviceMemorySampler(backend=backend,
+                                         leak_bytes_per_window=0)
+        recs = [s.sample(w) for w in range(5)]
+        for rec in recs:
+            assert rec["event"] == "device_memory"
+            assert set(rec) == {
+                "event", "window", "hbm_bytes_in_use", "hbm_peak_bytes",
+                "hbm_limit_bytes", "compile_cache_entries",
+            }
+        peaks = [r["hbm_peak_bytes"] for r in recs]
+        assert peaks == sorted(peaks)  # peak never decreases
+        # Window 4 repeats window 0's ripple: the sampler is pure in the
+        # window index (the bench's bit-identical replay depends on it).
+        assert (recs[4]["hbm_bytes_in_use"]
+                == recs[0]["hbm_bytes_in_use"])
+
+    def test_leak_inflates_reported_bytes_linearly(self):
+        backend = devstats.FakeMemoryBackend()
+        s = devstats.DeviceMemorySampler(backend=backend,
+                                         leak_bytes_per_window=100)
+        base = devstats.DEFAULT_FAKE_BASE_BYTES
+        assert s.sample(0)["hbm_bytes_in_use"] == base + 100
+        assert s.sample(3)["hbm_bytes_in_use"] == base + 400
+
+    def test_leak_defaults_from_env(self, monkeypatch):
+        monkeypatch.setenv(constants.ENV_MEM_LEAK_BYTES, "2048")
+        s = devstats.DeviceMemorySampler(
+            backend=devstats.FakeMemoryBackend()
+        )
+        assert s.leak_bytes_per_window == 2048
+        monkeypatch.setenv(constants.ENV_MEM_LEAK_BYTES, "not-a-number")
+        assert devstats.DeviceMemorySampler().leak_bytes_per_window == 0
+        monkeypatch.setenv(constants.ENV_MEM_LEAK_BYTES, "-5")
+        assert devstats.DeviceMemorySampler().leak_bytes_per_window == 0
+
+    def test_compile_cache_fn_failures_degrade_to_zero(self):
+        s = devstats.DeviceMemorySampler(
+            backend=devstats.FakeMemoryBackend(),
+            compile_cache_fn=lambda: (_ for _ in ()).throw(RuntimeError()),
+        )
+        assert s.sample(0)["compile_cache_entries"] == 0
+
+    def test_real_backend_fallback_never_raises(self):
+        # On the CPU test mesh memory_stats() is typically absent; the
+        # sampler must degrade to the live-array sum (limit 0), never an
+        # exception.
+        rec = devstats.DeviceMemorySampler().sample(0)
+        assert rec["hbm_bytes_in_use"] >= 0
+        assert rec["hbm_limit_bytes"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# Window join semantics
+# ---------------------------------------------------------------------------
+
+
+class TestMemoryMatrixJoin:
+    def test_roster_gates_window_until_gang_reports(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 4)
+        matrix.observe_pod(worker_pod(0, record=memsample(0, 500)))
+        assert matrix.pressure_verdict("default", "j1") is None
+        for i in (1, 2, 3):
+            matrix.observe_pod(worker_pod(i, record=memsample(0, 100)))
+        verdict = matrix.pressure_verdict("default", "j1")
+        assert verdict is not None
+        assert verdict["window"] == 0
+        assert verdict["pressure"] is False
+        # Fleet watermark = worst worker; headroom from the tightest limit.
+        assert verdict["top_worker"] == "0"
+        assert verdict["headroom_ratio"] == pytest.approx(0.5)
+
+    def test_single_member_window_still_joins(self):
+        # Unlike step skew (meaningless for a gang of one), one worker's
+        # HBM watermark is a real signal — solo windows close and count.
+        matrix, _ = make_matrix()
+        matrix.observe_pod(worker_pod(0, record=memsample(0, 800)))
+        verdict = matrix.pressure_verdict("default", "j1")
+        assert verdict is not None
+        assert verdict["headroom_ratio"] == pytest.approx(0.2)
+
+    def test_duplicate_delivery_is_idempotent(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 2)
+        matrix.observe_pod(worker_pod(0, record=memsample(0, 100)))
+        matrix.observe_pod(worker_pod(0, record=memsample(0, 100)))
+        matrix.observe_pod(worker_pod(1, record=memsample(0, 200)))
+        snap = matrix.job_snapshot("default", "j1")
+        assert [w["window"] for w in snap["windows"]] == [0]
+        assert snap["windows"][0]["workers"] == 2
+
+    def test_lagged_windows_force_close_and_terminal_pod_leaves_roster(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 4)
+        for window in range(devstats.MAX_OPEN_WINDOW_LAG + 1):
+            for i in (0, 1, 2):
+                matrix.observe_pod(
+                    worker_pod(i, record=memsample(window, 100))
+                )
+        verdict = matrix.pressure_verdict("default", "j1")
+        assert verdict is not None and verdict["window"] == 0
+        matrix.observe_pod(worker_pod(3, phase="Failed"))
+        verdict = matrix.pressure_verdict("default", "j1")
+        assert verdict["window"] == devstats.MAX_OPEN_WINDOW_LAG
+
+    def test_limitless_samples_report_but_never_project(self):
+        # live_arrays fallback: limit 0.  Watermarks surface, headroom
+        # pins to 1.0, and the projector refuses to extrapolate.
+        matrix, _ = make_matrix()
+        for window in range(6):
+            matrix.observe_pod(worker_pod(
+                0, record=memsample(window, 100 * (window + 1), limit=0)
+            ))
+        verdict = matrix.pressure_verdict("default", "j1")
+        assert verdict["pressure"] is False
+        assert verdict["projected_windows"] is None
+        assert verdict["headroom_ratio"] == 1.0
+
+    def test_non_worker_and_malformed_pods_ignored(self):
+        matrix, _ = make_matrix()
+        matrix.observe_pod(
+            worker_pod(0, role="launcher", record=memsample(0, 100))
+        )
+        pod = worker_pod(1, record=memsample(0, 100))
+        del pod["metadata"]["labels"][constants.JOB_NAME_LABEL]
+        matrix.observe_pod(pod)
+        matrix.observe_pod(worker_pod(2, record={"not": "a sample"}))
+        bad = worker_pod(3)
+        bad["metadata"]["annotations"] = {
+            constants.DEVICE_MEMORY_ANNOTATION: "{not json"
+        }
+        matrix.observe_pod(bad)
+        assert len(matrix) == 0
+
+    def test_constructor_validation(self):
+        fr = flightrecorder.FlightRecorder()
+        with pytest.raises(ValueError, match="pressure_horizon_windows"):
+            devstats.MemoryMatrix(fr, pressure_horizon_windows=0)
+        with pytest.raises(ValueError, match="trend_windows"):
+            devstats.MemoryMatrix(fr, trend_windows=1)
+
+
+# ---------------------------------------------------------------------------
+# The watermark-trend projector
+# ---------------------------------------------------------------------------
+
+
+class TestPressureProjector:
+    def test_linear_leak_fires_within_horizon(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 2)
+        # 100 bytes/window against a 1000-byte limit: exhaustion at
+        # window 9, so projection hits the 6-window horizon at window 3.
+        fired_at = None
+        for window in range(6):
+            emit_window(matrix, window,
+                        [100 * (window + 1), 50])
+            verdict = matrix.pressure_verdict("default", "j1")
+            if verdict["pressure"] and fired_at is None:
+                fired_at = window
+        assert fired_at == 3
+        verdict = matrix.pressure_verdict("default", "j1")
+        assert verdict["projected_windows"] == pytest.approx(4.0)
+        assert verdict["top_worker"] == "0"
+
+    def test_needs_min_trend_windows_before_projecting(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 2)
+        # Two windows of a catastrophic trend: still no projection —
+        # two points cannot tell a leak from a resharding step.
+        for window in range(devstats.MIN_TREND_WINDOWS - 1):
+            emit_window(matrix, window, [400 * (window + 1), 50])
+        verdict = matrix.pressure_verdict("default", "j1")
+        assert verdict["pressure"] is False
+        assert verdict["projected_windows"] is None
+
+    def test_trendless_ripple_never_fires(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 2)
+        for window in range(12):
+            ripple = (window % 4) * 10
+            emit_window(matrix, window, [500 + ripple, 400])
+        assert matrix.pressure_verdict("default", "j1")["pressure"] is False
+
+    def test_exhausted_watermark_is_immediate_pressure(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 1)
+        for window, in_use in enumerate([200, 600, 1000]):
+            emit_window(matrix, window, [in_use])
+        verdict = matrix.pressure_verdict("default", "j1")
+        assert verdict["pressure"] is True
+        assert verdict["projected_windows"] == 0.0
+        assert verdict["headroom_ratio"] == pytest.approx(0.0)
+
+    def test_recovery_flips_pressure_off(self):
+        matrix, _ = make_matrix()
+        register_roster(matrix, 1)
+        for window in range(4):
+            emit_window(matrix, window, [200 * (window + 1)])
+        assert matrix.pressure_verdict("default", "j1")["pressure"] is True
+        # The leak is fixed (eviction, resharding): one big drop pushes
+        # the projection far past the horizon again.
+        emit_window(matrix, 4, [100])
+        verdict = matrix.pressure_verdict("default", "j1")
+        assert verdict["pressure"] is False
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+
+class TestOOMForensics:
+    def test_oom_death_freezes_last_snapshot(self):
+        matrix, fr = make_matrix()
+        register_roster(matrix, 2)
+        for window in range(3):
+            emit_window(matrix, window, [300 * (window + 1), 100])
+        matrix.observe_pod(
+            worker_pod(0, phase="Failed", status=oom_status())
+        )
+        entries = fr.timeline("default", "j1", kind=flightrecorder.MEMORY)
+        assert len(entries) == 1
+        entry = entries[0]
+        assert entry["reason"] == "OOMKilled"
+        assert "exit code 137" in entry["message"]
+        assert entry["worker"] == "0"
+        assert entry["window"] == 2
+        assert entry["hbm_bytes_in_use"] == 900
+        assert entry["top_worker"] == "0"
+        # The snapshot remembers who OOMed even after the roster forgets.
+        snap = matrix.job_snapshot("default", "j1")
+        assert snap["oom_workers"] == ["0"]
+        assert "0" not in snap["workers"]
+
+    def test_oom_freeze_happens_once_per_worker(self):
+        matrix, fr = make_matrix()
+        register_roster(matrix, 2)
+        emit_window(matrix, 0, [500, 100])
+        for _ in range(3):
+            matrix.observe_pod(
+                worker_pod(0, phase="Failed", status=oom_status())
+            )
+        assert len(
+            fr.timeline("default", "j1", kind=flightrecorder.MEMORY)
+        ) == 1
+
+    def test_ordinary_death_leaves_no_memory_entry(self):
+        matrix, fr = make_matrix()
+        register_roster(matrix, 2)
+        emit_window(matrix, 0, [500, 100])
+        matrix.observe_pod(worker_pod(0, phase="Failed", status={
+            "containerStatuses": [
+                {"state": {"terminated": {"exitCode": 1}}}
+            ]
+        }))
+        # A clean exit records nothing: the job is either unseen by the
+        # recorder entirely (None) or has no memory-kind entries.
+        assert not fr.timeline("default", "j1",
+                               kind=flightrecorder.MEMORY)
+
+
+# ---------------------------------------------------------------------------
+# Metrics + LRU-transitive pruning
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsAndPruning:
+    def test_scrape_exposes_hbm_gauges(self):
+        registry = metrics.Registry()
+        fr = flightrecorder.FlightRecorder(clock=lambda: 0.0)
+        matrix = devstats.MemoryMatrix(fr, registry=registry)
+        fr.record("default", "j1", flightrecorder.EVENT, reason="Created")
+        register_roster(matrix, 2)
+        emit_window(matrix, 0, [600, 100])
+        text = registry.expose()
+        assert (
+            'tpu_operator_job_hbm_peak_bytes'
+            '{namespace="default",tpujob="j1"} 600.0' in text
+        )
+        assert (
+            'tpu_operator_job_hbm_headroom_ratio'
+            '{namespace="default",tpujob="j1"} 0.4' in text
+        )
+
+    def test_recorder_eviction_prunes_matrix_and_gauge_series(self):
+        registry = metrics.Registry()
+        fr = flightrecorder.FlightRecorder(max_jobs=2, clock=lambda: 0.0)
+        matrix = devstats.MemoryMatrix(fr, registry=registry)
+        for job in ("a", "b"):
+            fr.record("default", job, flightrecorder.EVENT, reason="Created")
+            for i in range(2):
+                matrix.observe_pod(worker_pod(i, job=job))
+            emit_window(matrix, 0, [100, 200], job=job)
+        text = registry.expose()
+        assert 'tpujob="a"' in text and 'tpujob="b"' in text
+        assert len(matrix) == 2
+
+        fr.record("default", "c", flightrecorder.EVENT, reason="Created")
+        fr.record("default", "d", flightrecorder.EVENT, reason="Created")
+        assert fr.timeline("default", "a") is None
+        text = registry.expose()
+        assert 'tpujob="a"' not in text and 'tpujob="b"' not in text
+        assert len(matrix) == 0
+        assert matrix.job_snapshot("default", "a") is None
+
+
+# ---------------------------------------------------------------------------
+# MemoryLeak chaos
+# ---------------------------------------------------------------------------
+
+
+class TestLeakInjectorChaos:
+    def _fleet(self, seed, leak_rate=1.0, bytes_per_window=4096,
+               max_leak=0, recorder=None):
+        api = InMemoryAPIServer()
+        for i in range(4):
+            api.create("pods", worker_pod(i))
+        engine = chaos.ChaosEngine(chaos.ChaosPolicy(
+            seed=seed,
+            leak=(chaos.MemoryLeakChaos(
+                leak_rate=leak_rate, bytes_per_window=bytes_per_window,
+                namespace="default", max_leak=max_leak,
+            ),),
+        ))
+
+        class Runner:
+            calls = []
+
+            def leak_worker(self, namespace, name, bpw):
+                self.calls.append((namespace, name, bpw))
+                return True
+
+        runner = Runner()
+        injector = chaos.LeakInjector(
+            engine, api, runner, flight_recorder=recorder
+        )
+        return api, engine, injector, runner
+
+    def test_budget_caps_and_victims_leak_once(self):
+        _, engine, injector, runner = self._fleet(seed=1, max_leak=2)
+        assert injector.tick() == 2
+        assert injector.tick() == 0  # budget spent, victims remembered
+        assert len(runner.calls) == 2
+        events = [e for e in engine.timeline() if e[0] == chaos.MEM_LEAK]
+        assert len(events) == 2
+        assert all(
+            detail == "bytes_per_window=4096" for _, _, detail in events
+        )
+        assert engine.pod_leaks_total.value() == 2
+
+    def test_same_seed_same_victims(self):
+        _, engine_a, injector_a, _ = self._fleet(seed=7, leak_rate=0.5)
+        _, engine_b, injector_b, _ = self._fleet(seed=7, leak_rate=0.5)
+        injector_a.tick()
+        injector_b.tick()
+        assert engine_a.timeline() == engine_b.timeline()
+        assert engine_a.timeline()  # the seed does leak someone
+
+    def test_only_running_worker_pods_are_candidates(self):
+        api, _, injector, runner = self._fleet(seed=1)
+        for pod in api.list("pods"):
+            pod["status"] = {"phase": "Pending"}
+            api.update_status("pods", pod)
+        api.create("pods", worker_pod(9, job="j2", role="launcher"))
+        assert injector.tick() == 0
+        assert runner.calls == []
+
+    def test_landed_leak_recorded_on_victim_job_timeline(self):
+        fr = flightrecorder.FlightRecorder(clock=lambda: 0.0)
+        _, _, injector, _ = self._fleet(seed=1, max_leak=1, recorder=fr)
+        assert injector.tick() == 1
+        entries = fr.timeline("default", "j1", kind=flightrecorder.MEM_LEAK)
+        assert len(entries) == 1
+        assert entries[0]["reason"] == "ChaosInjected"
+        assert "4096 bytes/window" in entries[0]["message"]
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            chaos.MemoryLeakChaos(leak_rate=0.5, bytes_per_window=-1)
+        with pytest.raises(ValueError):
+            chaos.MemoryLeakChaos(leak_rate=1.5)
+
+
+# ---------------------------------------------------------------------------
+# Controller integration: the MemoryPressure condition
+# ---------------------------------------------------------------------------
+
+
+class TestControllerMemoryPressureCondition:
+    def _emit(self, f, job, window, in_uses, limit=LIMIT):
+        for i, in_use in enumerate(in_uses):
+            pod = f.api.get("pods", "default", f"{job.name}-worker-{i}")
+            pod["metadata"].setdefault("annotations", {})[
+                constants.DEVICE_MEMORY_ANNOTATION
+            ] = json.dumps(memsample(window, in_use, limit=limit),
+                           sort_keys=True)
+            f.api.update("pods", pod)
+        f.sync(job)
+
+    def test_condition_set_then_recovered(self):
+        f = Fixture()
+        job = make_synced_job(f)
+        f.set_all_workers_phase(job, "Running")
+        f.sync(job)
+        # Worker 0 leaks 100 bytes/window toward the 1000-byte limit:
+        # the projection crosses the 6-window horizon at window 3.
+        for window in range(4):
+            self._emit(f, job, window,
+                       [100 * (window + 1), 50, 50, 50])
+        job = f.get_job()
+        assert st.has_condition(job.status, JOB_MEMORY_PRESSURE)
+        cond = next(
+            c for c in job.status.conditions
+            if c.type == JOB_MEMORY_PRESSURE
+        )
+        assert cond.reason == st.TPUJOB_MEMORY_PRESSURE_REASON
+        assert "device-memory pressure" in cond.message
+        reasons = [r for _, r in f.events()]
+        assert reasons.count(st.TPUJOB_MEMORY_PRESSURE_REASON) == 1
+
+        # The footprint collapses (leak fixed): the condition flips to
+        # False with the recovery reason and a Normal event.
+        self._emit(f, job, 4, [50, 50, 50, 50])
+        job = f.get_job()
+        assert not st.has_condition(job.status, JOB_MEMORY_PRESSURE)
+        cond = next(
+            c for c in job.status.conditions
+            if c.type == JOB_MEMORY_PRESSURE
+        )
+        assert cond.status == st.CONDITION_FALSE
+        assert cond.reason == st.TPUJOB_MEMORY_RECOVERED_REASON
+        assert st.TPUJOB_MEMORY_RECOVERED_REASON in [
+            r for _, r in f.events()
+        ]
+
+    def test_healthy_gang_never_flagged(self):
+        f = Fixture()
+        job = make_synced_job(f)
+        f.set_all_workers_phase(job, "Running")
+        f.sync(job)
+        for window in range(6):
+            ripple = (window % 3) * 5
+            self._emit(f, job, window,
+                       [400 + ripple, 390, 380, 410])
+        job = f.get_job()
+        assert not any(
+            c.type == JOB_MEMORY_PRESSURE for c in job.status.conditions
+        )
+
+
+# ---------------------------------------------------------------------------
+# The memory bench (smoke tier here; the scaled tier is marked slow)
+# ---------------------------------------------------------------------------
+
+
+class TestBenchMemorySmoke:
+    def test_leak_arm_detects_with_full_horizon_lead(self):
+        result = bench.run_arm(
+            bench.LEAK_BYTES, jobs=2, seed=42, windows=28
+        )
+        assert result["false_positive_jobs"] == 0
+        assert result["detected_jobs"] == result["leaked_jobs"]
+        if result["leaked_jobs"]:
+            assert result["exhausted_jobs"] == result["leaked_jobs"]
+            assert (
+                result["detection_lead_min"]
+                >= devstats.DEFAULT_PRESSURE_HORIZON_WINDOWS
+            )
+
+    def test_control_arm_never_fires(self):
+        result = bench.run_arm(0, jobs=2, seed=42, windows=12)
+        assert result["leaked_workers"] == 0
+        assert result["detected_jobs"] == 0
+        assert result["false_positive_jobs"] == 0
+        assert result["exhausted_jobs"] == 0
+
+    def test_same_seed_bit_identical_document(self):
+        a = bench.build_doc(bench.LEAK_BYTES, jobs=2, seed=11, windows=28)
+        b = bench.build_doc(bench.LEAK_BYTES, jobs=2, seed=11, windows=28)
+        assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+        bench.check_schema(a)
+
+    def test_schema_check_rejects_violations(self):
+        doc = bench.build_doc(bench.LEAK_BYTES, jobs=2, seed=3, windows=28)
+        bench.check_schema(doc)
+        import copy
+
+        broken = copy.deepcopy(doc)
+        del broken["results"][1]["detection_lead_min"]
+        with pytest.raises(ValueError, match="detection_lead_min"):
+            bench.check_schema(broken)
+
+        broken = copy.deepcopy(doc)
+        broken["results"][0]["detected_jobs"] = 1
+        with pytest.raises(ValueError, match="control arm"):
+            bench.check_schema(broken)
+
+        broken = copy.deepcopy(doc)
+        if broken["results"][1]["leaked_jobs"]:
+            broken["results"][1]["detection_lead_min"] = 0
+            with pytest.raises(ValueError, match="detection_lead_min"):
+                bench.check_schema(broken)
+
+        broken = copy.deepcopy(doc)
+        broken["results"][1]["false_positive_jobs"] = 2
+        with pytest.raises(ValueError, match="false_positive"):
+            bench.check_schema(broken)
+
+
+@pytest.mark.slow
+class TestBenchMemoryScaled:
+    def test_fleet_scale_document_passes_gates(self):
+        doc = bench.build_doc(bench.LEAK_BYTES, jobs=16, seed=42, windows=32)
+        bench.check_schema(doc)
+        leak_arm = doc["results"][1]
+        assert leak_arm["leaked_jobs"] > 0
+        assert leak_arm["detected_jobs"] == leak_arm["leaked_jobs"]
+        assert (
+            leak_arm["detection_lead_min"]
+            >= doc["detector"]["pressure_horizon_windows"]
+        )

@@ -22,7 +22,14 @@ import pytest
 
 from mpi_operator_tpu.api.v2beta1 import constants
 from mpi_operator_tpu.runtime.workqueue import RateLimitingQueue, WorkqueueMetrics
-from mpi_operator_tpu.utils import events, flightrecorder, metrics, telemetry, trace
+from mpi_operator_tpu.utils import (
+    devstats,
+    events,
+    flightrecorder,
+    metrics,
+    telemetry,
+    trace,
+)
 from mpi_operator_tpu.utils import logging as logutil
 
 from tests.test_controller import Fixture, make_synced_job
@@ -653,6 +660,62 @@ class TestStepHeartbeats:
         tm2.close(1)
         assert buf2.getvalue() == ""
 
+    def test_double_sigterm_emits_final_records_once(self):
+        """Kubelet sends SIGTERM, the grace period lapses, a second
+        SIGTERM lands mid-flush: the shared FinalOnce latch must make
+        the second ``close(final=True)`` degrade to a plain close — one
+        final telemetry record, one final device-memory record, total."""
+        sampler = devstats.DeviceMemorySampler(
+            backend=devstats.FakeMemoryBackend()
+        )
+        tm, _, buf = self._telem(interval=0, devstats_sampler=sampler.sample)
+        tm.start()
+        tm.record_step(1, 0.1)
+        tm.close(1, final=True)
+        tm.close(1, final=True)  # the second signal
+        recs = [json.loads(ln) for ln in buf.getvalue().strip().splitlines()]
+        finals = [r for r in recs if r.get("final")]
+        assert sorted(r["event"] for r in finals) == [
+            "device_memory", "train_telemetry",
+        ]
+
+    def test_final_once_latch_is_claim_once(self):
+        latch = telemetry.FinalOnce()
+        assert latch.claimed is False
+        assert latch.claim() is True
+        assert latch.claim() is False
+        assert latch.claimed is True
+
+    def test_device_memory_rides_every_heartbeat_window(self, monkeypatch):
+        monkeypatch.setenv(constants.ENV_TPU_WORKER_ID, "3")
+        monkeypatch.setenv("HOSTNAME", "host-3.example")
+        sampler = devstats.DeviceMemorySampler(
+            backend=devstats.FakeMemoryBackend()
+        )
+        tm, _, buf = self._telem(
+            heartbeat_interval=2, devstats_sampler=sampler.sample
+        )
+        tm.start()
+        for step in range(1, 7):
+            tm.record_step(step, 0.1)
+        recs = [json.loads(ln) for ln in buf.getvalue().strip().splitlines()]
+        mem = [r for r in recs if r["event"] == "device_memory"]
+        hb = [r for r in recs if r["event"] == "step_heartbeat"]
+        assert [r["window"] for r in mem] == [r["window"] for r in hb] == [
+            0, 1, 2,
+        ]
+        for rec in mem:
+            assert rec["hbm_limit_bytes"] == devstats.DEFAULT_FAKE_LIMIT_BYTES
+            assert "worker_id" in rec and "hostname" in rec  # identity stamp
+
+    def test_broken_devstats_sampler_never_breaks_the_loop(self):
+        tm, _, buf = self._telem(
+            heartbeat_interval=1, devstats_sampler=lambda w: 1 / 0
+        )
+        tm.start()
+        tm.record_step(1, 0.1)  # must not raise
+        assert len(self._heartbeats(buf)) == 1
+
 
 # ---------------------------------------------------------------------------
 # Cross-process trace context
@@ -972,6 +1035,36 @@ class TestFlightRecorder:
         fr.forget("ns", "j")
         assert fr.timeline("ns", "j") is None
 
+    def test_chaos_kinds_round_trip_through_to_json(self):
+        """Chaos injections are first-class timeline entries: a
+        ``slow_worker`` or ``mem_leak`` entry survives the JSON dump
+        with its kind and attrs intact, and the kind filter isolates
+        each from the surrounding lifecycle noise."""
+        fr = flightrecorder.FlightRecorder(clock=lambda: 3.0)
+        fr.record("default", "j1", flightrecorder.POD,
+                  reason="Running", pod="j1-worker-0", phase="Running")
+        fr.record("default", "j1", flightrecorder.SLOW_WORKER,
+                  reason="ChaosInjected",
+                  message="pod j1-worker-0: slowed by factor=2.0",
+                  pod="j1-worker-0")
+        fr.record("default", "j1", flightrecorder.MEM_LEAK,
+                  reason="ChaosInjected",
+                  message="pod j1-worker-1: leaking 4096 bytes/window",
+                  pod="j1-worker-1")
+        obj = json.loads(fr.to_json("default", "j1"))
+        kinds = [e["kind"] for e in obj["entries"]]
+        assert kinds == ["pod", "slow_worker", "mem_leak"]
+        for kind, pod in (("slow_worker", "j1-worker-0"),
+                          ("mem_leak", "j1-worker-1")):
+            (entry,) = fr.timeline("default", "j1", kind=kind)
+            assert entry["pod"] == pod
+            assert entry["reason"] == "ChaosInjected"
+        # Every chaos kind is part of the recorder's closed vocabulary
+        # (what the timeline endpoint validates ?kind= against).
+        assert flightrecorder.SLOW_WORKER in flightrecorder.KINDS
+        assert flightrecorder.MEM_LEAK in flightrecorder.KINDS
+        assert flightrecorder.MEMORY in flightrecorder.KINDS
+
 
 # ---------------------------------------------------------------------------
 # Event aggregation (kube event-series analog)
@@ -1287,6 +1380,35 @@ class TestTimelineEndpoint:
             server.shutdown()
             server.server_close()
 
+    def test_chaos_kind_query_filters(self):
+        t = [0.0]
+        fr = flightrecorder.FlightRecorder(clock=lambda: t[0])
+        fr.record("default", "j1", flightrecorder.POD,
+                  reason="Running", phase="Running")
+        fr.record("default", "j1", flightrecorder.SLOW_WORKER,
+                  reason="ChaosInjected",
+                  message="pod j1-worker-0: slowed by factor=2.0")
+        fr.record("default", "j1", flightrecorder.MEM_LEAK,
+                  reason="ChaosInjected",
+                  message="pod j1-worker-1: leaking 4096 bytes/window")
+        server, base = _monitoring_server(flight_recorder=fr)
+        try:
+            def fetch(query):
+                resp = urllib.request.urlopen(
+                    base + "/debug/jobs/default/j1/timeline" + query,
+                    timeout=5,
+                )
+                return json.loads(resp.read().decode())["entries"]
+
+            (slow,) = fetch("?kind=slow_worker")
+            assert "factor=2.0" in slow["message"]
+            (leak,) = fetch("?kind=mem_leak")
+            assert "4096 bytes/window" in leak["message"]
+            assert fetch("?kind=memory") == []  # valid kind, no entries
+        finally:
+            server.shutdown()
+            server.server_close()
+
     def test_malformed_query_values_400(self):
         server, base = _monitoring_server(
             flight_recorder=self._filter_fixture()
@@ -1396,11 +1518,195 @@ class TestStepsEndpoint:
             body = json.loads(err.read().decode())
             assert body["error"] == "unknown subresource 'bogus'"
             assert body["known_subresources"] == [
-                "goodput", "steps", "timeline",
+                "goodput", "memory", "steps", "timeline",
             ]
         finally:
             server.shutdown()
             server.server_close()
+
+
+class TestMemoryEndpoint:
+    """/debug/jobs/<ns>/<name>/memory serves the live device-memory
+    matrix (utils/devstats.py) the same way /steps serves the step-skew
+    one."""
+
+    def _matrix(self):
+        from mpi_operator_tpu.api.v2beta1 import constants as c
+        from mpi_operator_tpu.utils import devstats
+
+        fr = flightrecorder.FlightRecorder(clock=lambda: 0.0)
+        matrix = devstats.MemoryMatrix(fr, clock=lambda: 0.0)
+
+        def pod(i, record=None):
+            doc = {
+                "metadata": {
+                    "name": f"j1-worker-{i}",
+                    "namespace": "default",
+                    "labels": {
+                        c.JOB_NAME_LABEL: "j1",
+                        c.JOB_ROLE_LABEL: c.ROLE_WORKER,
+                        c.REPLICA_INDEX_LABEL: str(i),
+                    },
+                },
+                "status": {"phase": "Running"},
+            }
+            if record is not None:
+                doc["metadata"]["annotations"] = {
+                    c.DEVICE_MEMORY_ANNOTATION: json.dumps(record)
+                }
+            return doc
+
+        for i in range(2):
+            matrix.observe_pod(pod(i))
+        for window in range(3):
+            for i in range(2):
+                matrix.observe_pod(pod(i, {
+                    "event": "device_memory",
+                    "window": window,
+                    "hbm_bytes_in_use": 400 + 100 * i,
+                    "hbm_peak_bytes": 400 + 100 * i,
+                    "hbm_limit_bytes": 1000,
+                    "compile_cache_entries": 0,
+                }))
+        return matrix
+
+    def test_memory_serves_matrix_snapshot(self):
+        server, base = _monitoring_server(memory_matrix=self._matrix())
+        try:
+            resp = urllib.request.urlopen(
+                base + "/debug/jobs/default/j1/memory", timeout=5
+            )
+            assert resp.headers["Content-Type"] == "application/json"
+            snap = json.loads(resp.read().decode())
+            assert snap["name"] == "j1" and snap["pressure"] is False
+            assert snap["hbm_limit_bytes"] == 1000
+            assert snap["top_worker"] == "1"
+            assert sorted(snap["workers"]) == ["0", "1"]
+            assert snap["windows"] and snap["windows"][0]["workers"] == 2
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_memory_404_without_matrix_or_for_unknown_job(self):
+        for attrs in ({}, {"memory_matrix": self._matrix()}):
+            server, base = _monitoring_server(**attrs)
+            try:
+                with pytest.raises(urllib.error.HTTPError) as exc_info:
+                    urllib.request.urlopen(
+                        base + "/debug/jobs/default/ghost/memory", timeout=5
+                    )
+                assert exc_info.value.code == 404
+            finally:
+                server.shutdown()
+                server.server_close()
+
+
+class TestJobsIndexEndpoint:
+    """/debug/jobs lists every recorded job and which subresources have
+    live data for it — the postmortem's front door."""
+
+    def _recorder(self):
+        fr = flightrecorder.FlightRecorder(clock=lambda: 0.0)
+        fr.record("default", "j1", flightrecorder.EVENT, reason="Created")
+        fr.record("prod", "j2", flightrecorder.EVENT, reason="Created")
+        return fr
+
+    def test_index_lists_jobs_and_subresources(self):
+        matrix = TestMemoryEndpoint()._matrix()
+        server, base = _monitoring_server(
+            flight_recorder=self._recorder(), memory_matrix=matrix,
+        )
+        try:
+            for path in ("/debug/jobs", "/debug/jobs/"):
+                resp = urllib.request.urlopen(base + path, timeout=5)
+                assert resp.headers["Content-Type"] == "application/json"
+                body = json.loads(resp.read().decode())
+                assert body["known_subresources"] == [
+                    "goodput", "memory", "steps", "timeline",
+                ]
+                jobs = {
+                    (j["namespace"], j["name"]): j["subresources"]
+                    for j in body["jobs"]
+                }
+                # Every recorded job has a timeline; only j1 has joined
+                # device-memory windows, so only it advertises /memory.
+                assert jobs[("default", "j1")] == ["memory", "timeline"]
+                assert jobs[("prod", "j2")] == ["timeline"]
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_index_404_without_recorder(self):
+        server, base = _monitoring_server(flight_recorder=None)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(base + "/debug/jobs", timeout=5)
+            assert exc_info.value.code == 404
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_index_empty_recorder_serves_empty_list(self):
+        server, base = _monitoring_server(
+            flight_recorder=flightrecorder.FlightRecorder()
+        )
+        try:
+            body = json.loads(urllib.request.urlopen(
+                base + "/debug/jobs", timeout=5
+            ).read().decode())
+            assert body["jobs"] == []
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Doc drift: the metrics reference must cover every registered family
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsDocDrift:
+    """docs/observability.md is the operator's metrics reference; a
+    family registered in code but missing from its tables is
+    undocumented telemetry — exactly the drift this lint freezes out."""
+
+    _REGISTRATION = re.compile(
+        r"new_(?:counter|gauge|histogram)\(\s*[\"']"
+        r"(tpu_operator_[a-z0-9_]+)[\"']"
+    )
+
+    def _registered_families(self):
+        names = set()
+        pkg = REPO_ROOT / "mpi_operator_tpu"
+        for path in sorted(pkg.rglob("*.py")):
+            names.update(
+                self._REGISTRATION.findall(path.read_text(encoding="utf-8"))
+            )
+        return names
+
+    def _documented_families(self):
+        doc = (REPO_ROOT / "docs" / "observability.md").read_text(
+            encoding="utf-8"
+        )
+        names = set()
+        for line in doc.splitlines():
+            if line.lstrip().startswith("|"):
+                names.update(
+                    re.findall(r"`(tpu_operator_[a-z0-9_]+)`", line)
+                )
+        return names
+
+    def test_every_registered_family_has_a_doc_table_row(self):
+        registered = self._registered_families()
+        # The sweep must actually see the registrations (a refactor that
+        # moves them behind a helper should update this lint, not
+        # silently blind it).
+        assert len(registered) > 20
+        missing = registered - self._documented_families()
+        assert not missing, (
+            f"metric families registered in code but missing from a "
+            f"docs/observability.md table row: {sorted(missing)}"
+        )
 
 
 # ---------------------------------------------------------------------------
