@@ -110,10 +110,13 @@ test:
 	$(PYTHON) -m pytest tests -q $(XDIST)
 
 # Seeded goodput-under-preemption smoke (bench_goodput.py): 100 jobs at
-# kill rates 0/0.1/0.3 on the simulated clock, schema-checked artifact,
-# non-zero exit on non-convergence or a non-monotone goodput curve.
+# kill rates 0/0.1/0.3 per resilience arm (sync baseline vs async
+# checkpoints + hot spares) on the simulated clock, schema-checked
+# artifact, non-zero exit on non-convergence, a non-monotone goodput
+# curve, or any byte of drift from the committed BENCH_GOODPUT.json.
 bench-goodput:
-	$(PYTHON) bench_goodput.py --jobs 100 --seed 42 --out BENCH_GOODPUT.json
+	$(PYTHON) bench_goodput.py --jobs 100 --seed 42 \
+		--out BENCH_GOODPUT.json --baseline BENCH_GOODPUT.json
 
 # Seeded straggler-detection smoke (bench_straggler.py): gangs at
 # slowdown factors 1.0/2.0 on the simulated clock; gates detection

@@ -5,25 +5,48 @@
 this harness measures what the *jobs* get out of it — the fraction of
 each TPUJob's wall clock that was productive gang-running time, and
 where the rest went (queue wait, scheduling, pod startup, rendezvous,
-restart downtime), as attributed by the goodput ledger
+checkpointing, restart downtime), as attributed by the goodput ledger
 (utils/goodput.py) from flight-recorder timelines.
 
 It drives N queue-admitted, gang-scheduled TPUJobs to terminal state on
-a simulated clock at several chaos kill rates r (the PR-5 ``PodKiller``
-with the TPU preemption signature: SIGKILL 137 and node loss), with an
-``Ignore`` podFailurePolicy so preemptions never charge backoffLimit.
-Per rate it reports fleet goodput, per-phase wall seconds/shares, and
-the per-job per-phase *loss* versus the r=0 baseline — the curve the
-preemption papers (arxiv 1909.09756) draw from real fleets.
+a simulated clock, per chaos kill rate r (the PR-5 ``PodKiller`` with
+the TPU preemption signature: SIGKILL 137 and node loss) and per
+resilience arm:
+
+- ``sync``       — synchronous checkpointing (every save blocks the
+                   step path for the full write) and no standby
+                   capacity: a preempted worker re-runs the whole
+                   schedule→pending→bootstrap pipeline.
+- ``resilient``  — the PR-20 stack: async checkpointing (the step path
+                   pays only a host snapshot; a background write
+                   publishes the commit marker later) plus
+                   ``spec.tpu.hotSpares: 1`` standby workers the
+                   controller promotes into a dead worker's seat, so
+                   restart downtime collapses to rejoin time.
+
+Both arms run with an ``Ignore`` podFailurePolicy so preemptions never
+charge backoffLimit, and on clusters of identical capacity (the
+baseline arm simply leaves the standby headroom idle).  Per (arm, rate)
+the artifact reports fleet goodput, per-phase wall seconds/shares,
+spare promotions, and the per-job per-phase *loss* versus that arm's
+r=0 baseline — the curve the preemption papers (arxiv 1909.09756) draw
+from real fleets.  A ``checkpoint_scaling`` block re-runs the r=0
+fleet at two save frequencies per mode, demonstrating that sync
+checkpoint seconds scale with save frequency while async seconds do
+not (the write pipeline, not the save cadence, bounds them).
 
 Determinism: control logic runs on the simulated clock and every random
 choice comes from one ``random.Random(seed)`` (chaos draws from the
 seeded ChaosEngine), and every reported number derives from the sim
 clock — not wall time — so the same seed reproduces the artifact
-bit-for-bit.
+bit-for-bit.  ``--baseline`` turns that into a regression gate: when
+the given file exists, the freshly computed artifact must match it
+byte-for-byte.
 
 Run:  python bench_goodput.py --jobs 100 --seed 42
       python bench_goodput.py --jobs 200 --rates 0,0.1,0.3
+      python bench_goodput.py --out BENCH_GOODPUT.json \
+          --baseline BENCH_GOODPUT.json     # CI: diff against committed
 Emits BENCH_GOODPUT.json (schema-checked; see docs/observability.md)
 and prints one JSON summary line.
 """
@@ -32,6 +55,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
 import sys
 import time
@@ -72,8 +96,35 @@ WORKERS_PER_JOB = 4
 CHIPS_PER_JOB = 16
 # The acceptance curve: baseline, moderate, heavy preemption pressure.
 KILL_RATES = (0.0, 0.1, 0.3)
+# The resilience arms: today's stack vs the PR-20 stack.
+ARMS = ("sync", "resilient")
+HOT_SPARES = 1
 
-SCHEMA_VERSION = 1
+# Checkpoint cost model (simulated seconds / ticks).  A sync save
+# blocks the step path for the full write; an async save blocks it only
+# for the host snapshot, then a background writer spends
+# ASYNC_WRITE_TICKS off the step path before the commit marker lands —
+# and while a write is in flight no new snapshot is taken (the
+# one-writer-in-flight rule of utils/checkpoint.py), which is exactly
+# why async checkpoint seconds stop scaling with save frequency.
+SYNC_WRITE_S = 0.5
+ASYNC_SNAPSHOT_S = 0.02
+ASYNC_WRITE_TICKS = 2
+DEFAULT_SAVE_EVERY = 2
+
+# Per-arm save cadence: the sync arm saves every other step (paying the
+# full write every step would be absurd); the resilient arm saves every
+# step, because the async step-path cost is a host snapshot — affording
+# max-frequency saves is exactly what async checkpointing buys.
+ARM_SAVE_EVERY = {"sync": 2, "resilient": 1}
+
+# Cold pod startup: a freshly bound pod spends this many ticks Pending
+# (image pull, TPU runtime init, rendezvous bootstrap) before Running.
+# A promoted hot spare's replacement skips it entirely — the standby
+# already paid it while parked — which is the whole point of spares.
+STARTUP_TICKS = 3
+
+SCHEMA_VERSION = 2
 
 
 def log(*args):
@@ -81,24 +132,48 @@ def log(*args):
 
 
 class GoodputRunner:
-    """bench_controlplane.BenchRunner plus the two things this bench
-    needs: every phase flip lands on the owning job's flight-recorder
-    timeline (the ledger's raw input — in production the LocalPodRunner
-    does this), and the ``kill_pod``/``fail_node`` surface the PR-5
-    ``PodKiller`` drives.  A bound pod stays Pending for one tick before
-    Running, so pod startup occupies real (simulated) time."""
+    """bench_controlplane.BenchRunner plus the things this bench needs:
+    every phase flip lands on the owning job's flight-recorder timeline
+    (the ledger's raw input — in production the LocalPodRunner does
+    this), the ``kill_pod``/``fail_node`` surface the PR-5 ``PodKiller``
+    drives, and a per-gang checkpoint/rollback model feeding
+    ``checkpoint_s`` telemetry into the goodput ledger.  A bound pod
+    stays Pending for STARTUP_TICKS ticks before Running — except a
+    promoted hot spare's replacement, which was already bootstrapped
+    and parked, so it goes Running on first sight (warm rejoin).
 
-    RUN_TICKS = 3
+    Progress model: a gang advances one tick per round in which every
+    worker is Running.  On disruption its progress rolls back to the
+    last *committed* save — sync commits at the save tick, async
+    commits when the background write finishes — so the redo work after
+    a kill is exactly what the checkpoint cadence left unprotected.
+    """
+
+    RUN_TICKS = 12
 
     def __init__(
         self,
         api: InMemoryAPIServer,
         recorder: flightrecorder.FlightRecorder,
+        ledger: goodput.GoodputLedger | None = None,
+        checkpoint_mode: str = "sync",
+        save_every: int = DEFAULT_SAVE_EVERY,
     ):
+        if checkpoint_mode not in ("sync", "async"):
+            raise ValueError(f"checkpoint_mode: {checkpoint_mode!r}")
+        if save_every < 1:
+            raise ValueError(f"save_every must be >= 1, got {save_every!r}")
         self.api = api
         self.recorder = recorder
+        self.ledger = ledger
+        self.checkpoint_mode = checkpoint_mode
+        self.save_every = save_every
         self._gang_age: dict[str, int] = {}
-        self._bound_seen: set[tuple[str, str]] = set()
+        self._saved: dict[str, int] = {}       # last committed step
+        self._snap_age: dict[str, int] = {}    # step of the in-flight write
+        self._write_left: dict[str, int] = {}  # async write ticks remaining
+        self._ckpt_s: dict[str, float] = {}    # cumulative step-path seconds
+        self._bound_ticks: dict[tuple[str, str], int] = {}
 
     def _flip(self, pod: dict, phase: str, reason: str = "",
               message: str = "", exit_code=None) -> None:
@@ -116,14 +191,42 @@ class GoodputRunner:
             }]
         pod["status"] = status
         self.api.update_status("pods", pod)
-        job_name = (meta.get("labels") or {}).get(constants.JOB_NAME_LABEL)
-        if job_name:
+        labels = meta.get("labels") or {}
+        job_name = labels.get(constants.JOB_NAME_LABEL)
+        # Standby pods are held capacity, not gang members: their
+        # lifecycle must not perturb the job's phase attribution.
+        if job_name and labels.get(
+            constants.JOB_ROLE_LABEL
+        ) != constants.ROLE_SPARE:
             attrs = {} if exit_code is None else {"exit_code": exit_code}
             self.recorder.record(
                 meta.get("namespace", ""), job_name, flightrecorder.POD,
                 reason=reason or phase, message=message,
                 pod=meta.get("name", ""), phase=phase, **attrs,
             )
+
+    def _checkpoint_tick(self, name: str, age: int) -> None:
+        """One productive tick's checkpoint accounting for gang ``name``."""
+        if self.checkpoint_mode == "sync":
+            if age % self.save_every == 0:
+                self._ckpt_s[name] = (
+                    self._ckpt_s.get(name, 0.0) + SYNC_WRITE_S
+                )
+                self._saved[name] = age
+            return
+        left = self._write_left.get(name, 0)
+        if left > 0:
+            left -= 1
+            self._write_left[name] = left
+            if left == 0:
+                # Background write finished: the snapshot commits.
+                self._saved[name] = self._snap_age.get(name, 0)
+        if self._write_left.get(name, 0) == 0 and age % self.save_every == 0:
+            self._ckpt_s[name] = (
+                self._ckpt_s.get(name, 0.0) + ASYNC_SNAPSHOT_S
+            )
+            self._snap_age[name] = age
+            self._write_left[name] = ASYNC_WRITE_TICKS
 
     def tick(self) -> None:
         for pod in self.api.list("pods"):
@@ -132,24 +235,36 @@ class GoodputRunner:
             status = pod.get("status") or {}
             phase = status.get("phase") or "Pending"
             if phase == "Pending" and (pod.get("spec") or {}).get("nodeName"):
-                # First sight of the binding: stage one tick of pod
-                # startup; second sight: the container comes up.
-                if key in self._bound_seen:
-                    self._bound_seen.discard(key)
+                annotations = meta.get("annotations") or {}
+                if constants.PROMOTED_FROM_ANNOTATION in annotations:
+                    # A promoted hot spare's seat: the standby already
+                    # paid cold startup while parked, so the replacement
+                    # rejoins warm — no staged Pending ticks.
                     self._flip(pod, "Running")
                 else:
-                    self._bound_seen.add(key)
+                    seen = self._bound_ticks.get(key, 0) + 1
+                    if seen >= STARTUP_TICKS:
+                        self._bound_ticks.pop(key, None)
+                        self._flip(pod, "Running")
+                    else:
+                        self._bound_ticks[key] = seen
             elif phase != "Pending":
-                self._bound_seen.discard(key)
+                self._bound_ticks.pop(key, None)
         gangs: dict[str, list[dict]] = {}
         for pod in self.api.list("pods"):
-            name = ((pod.get("metadata") or {}).get("labels") or {}).get(
-                constants.JOB_NAME_LABEL
-            )
-            if name:
+            labels = ((pod.get("metadata") or {}).get("labels") or {})
+            name = labels.get(constants.JOB_NAME_LABEL)
+            # Gang membership is workers only: parked spares carry the
+            # job label too but never join the barrier.
+            if name and labels.get(
+                constants.JOB_ROLE_LABEL
+            ) == constants.ROLE_WORKER:
                 gangs.setdefault(name, []).append(pod)
         for name in sorted(gangs):
             members = gangs[name]
+            namespace = (
+                (members[0].get("metadata") or {}).get("namespace", "")
+            )
             world = 0
             for pod in members:
                 stamp = (
@@ -164,11 +279,21 @@ class GoodputRunner:
             ):
                 age = self._gang_age.get(name, 0) + 1
                 self._gang_age[name] = age
+                self._checkpoint_tick(name, age)
+                if self.ledger is not None:
+                    self.ledger.observe_telemetry(namespace, name, {
+                        "checkpoint_s": round(
+                            self._ckpt_s.get(name, 0.0), 6
+                        ),
+                    })
                 if age >= self.RUN_TICKS:
                     for pod in members:
                         self._flip(pod, "Succeeded", exit_code=0)
             elif not all(ph == "Succeeded" for ph in phases):
-                self._gang_age[name] = 0
+                # Disruption: progress rolls back to the last committed
+                # save; an in-flight async write dies with the gang.
+                self._gang_age[name] = self._saved.get(name, 0)
+                self._write_left[name] = 0
 
     # -- PodKiller surface ----------------------------------------------
 
@@ -216,12 +341,12 @@ def ignore_preemption_rules() -> PodFailurePolicy:
     ])
 
 
-def goodput_job(name: str) -> TPUJob:
+def goodput_job(name: str, hot_spares: int = 0) -> TPUJob:
     job = TPUJob()
     job.metadata.name = name
     job.metadata.namespace = "default"
     job.spec = TPUJobSpec(
-        tpu=TPUSpec(accelerator_type="v5e-16"),
+        tpu=TPUSpec(accelerator_type="v5e-16", hot_spares=hot_spares),
         replica_specs={
             REPLICA_TYPE_WORKER: ReplicaSpec(
                 replicas=WORKERS_PER_JOB, template=dict(TEMPLATE)
@@ -238,13 +363,31 @@ def goodput_job(name: str) -> TPUJob:
 
 
 def run_rate(
-    kill_rate: float, jobs: int, seed: int, max_rounds: int = 0
+    kill_rate: float,
+    jobs: int,
+    seed: int,
+    max_rounds: int = 0,
+    arm: str = "sync",
+    save_every: int = 0,
 ) -> dict:
-    """Drive ``jobs`` TPUJobs to terminal state at one chaos kill rate;
-    return the per-rate result block of BENCH_GOODPUT.json.  Every
-    reported number derives from the simulated clock, so same seed =>
-    bit-identical block."""
+    """Drive ``jobs`` TPUJobs to terminal state at one chaos kill rate
+    under one resilience arm; return the per-(arm, rate) result block of
+    BENCH_GOODPUT.json.  Every reported number derives from the
+    simulated clock, so same seed => bit-identical block."""
+    if arm not in ARMS:
+        raise ValueError(f"arm: {arm!r} not in {ARMS}")
+    hot_spares = HOT_SPARES if arm == "resilient" else 0
+    checkpoint_mode = "async" if arm == "resilient" else "sync"
+    if save_every <= 0:
+        save_every = ARM_SAVE_EVERY[arm]
     concurrency = min(64, max(8, jobs // 16))
+    # Standby headroom: enough extra slices for every in-flight job to
+    # hold its spares as whole hosts.  Both arms get the same capacity —
+    # the baseline arm just leaves it idle — so the curves compare
+    # resilience mechanisms, not cluster sizes.
+    chips_per_host = CHIPS_PER_JOB // WORKERS_PER_JOB
+    spare_chips = concurrency * HOT_SPARES * chips_per_host
+    spare_slices = (spare_chips + CHIPS_PER_JOB - 1) // CHIPS_PER_JOB
     rng = random.Random(seed)
 
     time_ = [NOW]
@@ -256,7 +399,8 @@ def run_rate(
     )
     ledger = goodput.GoodputLedger(recorder, registry=registry, clock=clock)
 
-    register_nodes(raw, f"v5e-16:{concurrency}")
+    register_nodes(raw, f"v5e-16:{concurrency + spare_slices}")
+    # Quota stays worker-sized: spare pods never charge the ledger.
     bootstrap_queues(
         raw, [f"{BENCH_QUEUE}:v5e={CHIPS_PER_JOB * concurrency}"],
         namespace="default",
@@ -273,20 +417,31 @@ def run_rate(
         raw, registry=registry, clock=clock, gang_wait_timeout=1e9,
         flight_recorder=recorder,
     )
-    runner = GoodputRunner(raw, recorder)
+    runner = GoodputRunner(
+        raw, recorder, ledger=ledger,
+        checkpoint_mode=checkpoint_mode, save_every=save_every,
+    )
 
     killer = None
     engine = None
     kills_budget = 0
     if kill_rate > 0:
         # 90/10 SIGKILL/node-death mix, budgeted so the fleet converges
-        # once the chaos quota is spent.
-        kills_budget = max(1, int(jobs * kill_rate * 2))
+        # once the chaos quota is spent.  The curve parameter is
+        # preemption *pressure* (it sizes the budget); the per-pod
+        # per-tick rate is scaled well below it so the budget spreads
+        # over the run as isolated preemptions — a burst that guns down
+        # whole gangs in one tick is a correlated-failure study, not a
+        # preemption curve.
+        # Budget semantics: rate r means an r chance per job of being
+        # preempted once over its run.
+        kills_budget = max(1, int(jobs * kill_rate))
+        per_tick = kill_rate / 10.0
         engine = chaos.ChaosEngine(chaos.ChaosPolicy(
             seed=seed,
             pods=(chaos.PodChaos(
-                kill_rate=kill_rate * 0.9,
-                node_death_rate=kill_rate * 0.1,
+                kill_rate=per_tick * 0.9,
+                node_death_rate=per_tick * 0.1,
                 roles=(constants.ROLE_WORKER,),
                 namespace="default",
                 max_kills=kills_budget,
@@ -307,11 +462,12 @@ def run_rate(
 
     names = [f"goodput-{i:05d}" for i in range(jobs)]
     rng.shuffle(names)
-    log(f"creating {jobs} TPUJobs at kill rate {kill_rate} "
-        f"({WORKERS_PER_JOB}-worker gangs, concurrency {concurrency})...")
+    log(f"creating {jobs} TPUJobs at kill rate {kill_rate} arm {arm} "
+        f"({WORKERS_PER_JOB}-worker gangs, {hot_spares} spares, "
+        f"concurrency {concurrency})...")
     wall0 = time.perf_counter()
     for name in names:
-        raw.create("tpujobs", goodput_job(name).to_dict())
+        raw.create("tpujobs", goodput_job(name, hot_spares).to_dict())
 
     def pump():
         for _ in range(10):
@@ -337,9 +493,12 @@ def run_rate(
 
     if max_rounds <= 0:
         # Baseline waves plus a recovery allowance per budgeted kill
-        # (reschedule + startup + RUN_TICKS, padded).
+        # (reschedule + startup + RUN_TICKS redo, padded).
         waves = (jobs + concurrency - 1) // concurrency
-        max_rounds = 40 + 16 * waves + 12 * kills_budget
+        max_rounds = (
+            40 + (12 + STARTUP_TICKS + 2 * GoodputRunner.RUN_TICKS) * waves
+            + (4 + STARTUP_TICKS + GoodputRunner.RUN_TICKS) * kills_budget
+        )
 
     rounds_used = None
     try:
@@ -376,7 +535,7 @@ def run_rate(
         manager.sync_handler("bench-final")
     except ApiError:
         manager.sync_handler("bench-final-retry")
-    log(f"rate {kill_rate}: drove to round {rounds_used} in "
+    log(f"rate {kill_rate} arm {arm}: drove to round {rounds_used} in "
         f"{time.perf_counter() - wall0:.2f}s wall")
 
     # Ground-truth outcomes from the apiserver, not the counters.
@@ -401,21 +560,30 @@ def run_rate(
     residual = (
         abs(attributed - wall_total) / wall_total if wall_total > 0 else 0.0
     )
+    ckpt_per_job = (
+        fleet["phase_seconds"][goodput.PHASE_CHECKPOINT] / jobs
+        if jobs else 0.0
+    )
     return {
+        "arm": arm,
         "kill_rate": kill_rate,
         "jobs": jobs,
         "seed": seed,
         "concurrency": concurrency,
+        "hot_spares": hot_spares,
+        "save_every": save_every,
         "converged": converged,
         "rounds": rounds_used,
         "sim_seconds": round(time_[0] - NOW, 6),
         "outcomes": outcomes,
         "kills": kills,
         "restarts_total": fleet["restarts"],
+        "spare_promotions": int(controller.spare_promotions.value()),
         "goodput_ratio": fleet["goodput_ratio"],
         "wall_seconds_total": wall_total,
         "phase_seconds": fleet["phase_seconds"],
         "phase_shares": fleet["phase_shares"],
+        "checkpoint_seconds_per_job": round(ckpt_per_job, 6),
         "attribution_residual_ratio": round(residual, 6),
     }
 
@@ -425,21 +593,28 @@ def run_rate(
 # ----------------------------------------------------------------------
 
 _RESULT_KEYS = {
+    "arm": str,
     "kill_rate": float,
     "jobs": int,
     "seed": int,
+    "hot_spares": int,
+    "save_every": int,
     "converged": bool,
     "sim_seconds": float,
     "outcomes": dict,
     "kills": int,
     "restarts_total": int,
+    "spare_promotions": int,
     "goodput_ratio": float,
     "wall_seconds_total": float,
     "phase_seconds": dict,
     "phase_shares": dict,
+    "checkpoint_seconds_per_job": float,
     "attribution_residual_ratio": float,
     "loss_attribution_vs_baseline": dict,
 }
+
+_SCALING_KEYS = ("save_every_1", "save_every_2", "scaling_ratio")
 
 
 def check_schema(doc: dict) -> None:
@@ -454,10 +629,15 @@ def check_schema(doc: dict) -> None:
         )
     if doc.get("benchmark") != "goodput":
         raise ValueError(f"benchmark: got {doc.get('benchmark')!r}")
+    arms = doc.get("arms")
+    if not isinstance(arms, list) or not arms:
+        raise ValueError("arms: expected a non-empty list")
     curve = doc.get("curve")
     if not isinstance(curve, list) or not curve:
         raise ValueError("curve: expected a non-empty list")
     for i, point in enumerate(curve):
+        if point.get("arm") not in arms:
+            raise ValueError(f"curve[{i}].arm: {point.get('arm')!r}")
         for key in ("kill_rate", "goodput_ratio"):
             if not isinstance(point.get(key), (int, float)):
                 raise ValueError(f"curve[{i}].{key}: missing or non-numeric")
@@ -482,6 +662,8 @@ def check_schema(doc: dict) -> None:
                     f"{where}.{key}: expected {type_.__name__}, "
                     f"got {type(res[key]).__name__}"
                 )
+        if res["arm"] not in arms:
+            raise ValueError(f"{where}.arm: {res['arm']!r} not in arms")
         for field in ("phase_seconds", "phase_shares",
                       "loss_attribution_vs_baseline"):
             if set(res[field]) != vocabulary:
@@ -500,44 +682,104 @@ def check_schema(doc: dict) -> None:
             raise ValueError(
                 f"{where}.goodput_ratio: {res['goodput_ratio']} not in [0,1]"
             )
+    scaling = doc.get("checkpoint_scaling")
+    if not isinstance(scaling, dict):
+        raise ValueError("checkpoint_scaling: expected a dict")
+    for mode in ("sync", "async"):
+        block = scaling.get(mode)
+        if not isinstance(block, dict):
+            raise ValueError(f"checkpoint_scaling.{mode}: expected a dict")
+        for key in _SCALING_KEYS:
+            if not isinstance(block.get(key), (int, float)):
+                raise ValueError(
+                    f"checkpoint_scaling.{mode}.{key}: missing or "
+                    f"non-numeric"
+                )
 
 
 def build_doc(
     rates: list[float], jobs: int, seed: int, max_rounds: int = 0
 ) -> dict:
-    results = []
-    for rate in rates:
-        result = run_rate(rate, jobs, seed, max_rounds=max_rounds)
-        log(
-            f"rate {rate}: converged={result['converged']} in "
-            f"{result['rounds']} rounds, goodput "
-            f"{result['goodput_ratio']:.4f}, {result['kills']} kills, "
-            f"{result['restarts_total']} restarts"
-        )
-        results.append(result)
-    # Per-job average per-phase seconds lost versus the first rate (the
-    # baseline): where does preemption pressure put the time?
-    base = results[0]
-    for res in results:
-        res["loss_attribution_vs_baseline"] = {
-            p: round(
-                res["phase_seconds"][p] / res["jobs"]
-                - base["phase_seconds"][p] / base["jobs"], 6,
+    results: list[dict] = []
+    for arm in ARMS:
+        arm_results = []
+        for rate in rates:
+            result = run_rate(
+                rate, jobs, seed, max_rounds=max_rounds, arm=arm
             )
-            for p in goodput.GOODPUT_PHASES
+            log(
+                f"rate {rate} arm {arm}: converged={result['converged']} "
+                f"in {result['rounds']} rounds, goodput "
+                f"{result['goodput_ratio']:.4f}, {result['kills']} kills, "
+                f"{result['restarts_total']} restarts, "
+                f"{result['spare_promotions']} promotions"
+            )
+            arm_results.append(result)
+        # Per-job average per-phase seconds lost versus this arm's first
+        # rate (its baseline): where does preemption pressure put the
+        # time, and how much of it does each resilience arm buy back?
+        base = arm_results[0]
+        for res in arm_results:
+            res["loss_attribution_vs_baseline"] = {
+                p: round(
+                    res["phase_seconds"][p] / res["jobs"]
+                    - base["phase_seconds"][p] / base["jobs"], 6,
+                )
+                for p in goodput.GOODPUT_PHASES
+            }
+        results.extend(arm_results)
+
+    # Save-frequency scaling: same seeded fleet at r=0, save cadence 1
+    # vs 2 ticks, per checkpoint mode.  Sync seconds halve when the
+    # cadence halves (ratio ~2); async seconds are bounded by the write
+    # pipeline, not the cadence (ratio ~1).
+    scaling_jobs = min(jobs, 32)
+    scaling: dict[str, dict] = {}
+    for arm, mode in (("sync", "sync"), ("resilient", "async")):
+        per_cadence = {}
+        for cadence in (1, 2):
+            res = run_rate(
+                0.0, scaling_jobs, seed, max_rounds=max_rounds,
+                arm=arm, save_every=cadence,
+            )
+            per_cadence[cadence] = res["checkpoint_seconds_per_job"]
+        ratio = (
+            per_cadence[1] / per_cadence[2] if per_cadence[2] > 0 else 0.0
+        )
+        scaling[mode] = {
+            "save_every_1": per_cadence[1],
+            "save_every_2": per_cadence[2],
+            "scaling_ratio": round(ratio, 6),
         }
+        log(f"checkpoint scaling {mode}: se=1 {per_cadence[1]}s/job, "
+            f"se=2 {per_cadence[2]}s/job, ratio {ratio:.3f}")
+
     return {
         "benchmark": "goodput",
         "schema_version": SCHEMA_VERSION,
         "jobs": jobs,
         "seed": seed,
         "kill_rates": list(rates),
+        "arms": list(ARMS),
+        "hot_spares": HOT_SPARES,
+        "arm_save_every": dict(ARM_SAVE_EVERY),
+        "run_ticks": GoodputRunner.RUN_TICKS,
         "curve": [
-            {"kill_rate": r["kill_rate"], "goodput_ratio": r["goodput_ratio"]}
+            {
+                "arm": r["arm"],
+                "kill_rate": r["kill_rate"],
+                "goodput_ratio": r["goodput_ratio"],
+            }
             for r in results
         ],
         "results": results,
+        "checkpoint_scaling": scaling,
     }
+
+
+def canonical_bytes(doc: dict) -> bytes:
+    """The artifact's on-disk form: the unit of the --baseline gate."""
+    return (json.dumps(doc, indent=2, sort_keys=True) + "\n").encode()
 
 
 def main(argv=None) -> int:
@@ -552,36 +794,67 @@ def main(argv=None) -> int:
     p.add_argument("--max-rounds", type=int, default=0,
                    help="round budget per rate (0 = auto from fleet size)")
     p.add_argument("--out", default="BENCH_GOODPUT.json")
+    p.add_argument("--baseline", default="",
+                   help="committed artifact to diff against; when the "
+                        "file exists the fresh artifact must match it "
+                        "byte-for-byte (the CI regression gate)")
     args = p.parse_args(argv)
 
     logutil.configure(level=logutil.parse_level("warning"))
     rates = [float(r) for r in args.rates.split(",") if r.strip()]
     doc = build_doc(rates, args.jobs, args.seed, args.max_rounds)
     check_schema(doc)
-    with open(args.out, "w") as f:
-        json.dump(doc, f, indent=2, sort_keys=True)
-        f.write("\n")
+    payload = canonical_bytes(doc)
+
+    if args.baseline and os.path.exists(args.baseline):
+        with open(args.baseline, "rb") as f:
+            committed = f.read()
+        if committed != payload:
+            log(f"FAIL: artifact diverged from baseline {args.baseline} "
+                f"({len(committed)} committed bytes vs {len(payload)} "
+                f"fresh); re-run with --out to regenerate after an "
+                f"intentional change")
+            return 1
+        log(f"baseline {args.baseline}: bit-identical")
+    with open(args.out, "wb") as f:
+        f.write(payload)
     log(f"wrote {args.out}")
 
     curve = doc["curve"]
+    by_arm = {
+        arm: [pt for pt in curve if pt["arm"] == arm] for arm in doc["arms"]
+    }
+    # Relative goodput loss at the heaviest kill rate, per arm — the
+    # headline: the resilient arm should lose single-digit percent.
+    loss_pct = {}
+    for arm, points in by_arm.items():
+        g0 = points[0]["goodput_ratio"]
+        loss_pct[arm] = round(
+            100.0 * (g0 - points[-1]["goodput_ratio"]) / g0 if g0 else 0.0,
+            3,
+        )
     print(json.dumps({
         "metric": "goodput_vs_kill_rate",
-        "value": curve[-1]["goodput_ratio"],
+        "value": by_arm[doc["arms"][-1]][-1]["goodput_ratio"],
         "unit": (
             f"fleet goodput at kill rate {curve[-1]['kill_rate']} "
-            f"({doc['jobs']} jobs, seed {doc['seed']})"
+            f"({doc['jobs']} jobs, seed {doc['seed']}, "
+            f"arm {doc['arms'][-1]})"
         ),
         "curve": curve,
-        "restart_downtime_share": doc["results"][-1]["phase_shares"][
-            goodput.PHASE_RESTART_DOWNTIME
-        ],
+        "goodput_loss_pct_at_max_rate": loss_pct,
+        "checkpoint_scaling": doc["checkpoint_scaling"],
     }))
     ok = all(r["converged"] for r in doc["results"])
-    # Preemption must not *improve* goodput: the curve is monotone
-    # (within float dust) from the r=0 baseline down.
-    if curve[0]["goodput_ratio"] + 1e-9 < curve[-1]["goodput_ratio"]:
-        log("FAIL: goodput at baseline below goodput at max kill rate")
-        ok = False
+    # Preemption must not *improve* goodput: each arm's curve is
+    # monotone (within float dust) from its r=0 baseline down.
+    for arm, points in by_arm.items():
+        if points and (
+            points[0]["goodput_ratio"] + 1e-9 < points[-1]["goodput_ratio"]
+        ):
+            log(f"FAIL: arm {arm} goodput at baseline below goodput at "
+                f"max kill rate")
+            ok = False
     return 0 if ok else 1
 
 

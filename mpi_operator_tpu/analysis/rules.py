@@ -9,7 +9,7 @@ keeps its historic ``check_file`` API and flake8-style messages.
 Families:
 
 - TPU001–TPU005 — style tier (legacy aliases F401/B006/E722/F541/F811)
-- TPU101–TPU113 — Prometheus metric naming, required families,
+- TPU101–TPU114 — Prometheus metric naming, required families,
   and sole-writer metric prefixes
 - TPU201–TPU207 — control-plane hygiene (logging, sleep, swallowed
   exceptions, profiling phase vocabulary)
@@ -239,7 +239,7 @@ rule("TPU005", "redefinition",
 
 
 # ----------------------------------------------------------------------
-# TPU101–TPU113: Prometheus metric conventions
+# TPU101–TPU114: Prometheus metric conventions
 # ----------------------------------------------------------------------
 
 _METRIC_CTORS = ("new_counter", "new_gauge", "new_histogram")
@@ -403,6 +403,11 @@ _REQUIRED_FAMILIES = [
         "tpu_operator_job_hbm_peak_bytes",
         "tpu_operator_job_hbm_headroom_ratio",
     }),
+    ("mpi_operator_tpu/utils/checkpoint.py", {
+        "tpu_operator_job_checkpoint_snapshot_seconds",
+        "tpu_operator_job_checkpoint_write_seconds",
+        "tpu_operator_job_checkpoint_commits_total",
+    }),
 ]
 
 
@@ -490,6 +495,29 @@ def check_devstats_sole_writer(repo: RepoView) -> Iterable[Finding]:
                 sf.rel, line, "TPU113",
                 f"{kind}({name!r}): device-memory metric prefixes are "
                 f"reserved for {_DEVSTATS_OWNER}",
+            )
+
+
+# The checkpoint families narrate one durability pipeline (snapshot ->
+# background write -> commit marker): a second writer would interleave
+# foreign samples into the write/commit ratio that the torn-write
+# forensics read, and make "commits != saves" undiagnosable.
+_CHECKPOINT_PREFIXES = ("tpu_operator_job_checkpoint",)
+_CHECKPOINT_OWNER = "mpi_operator_tpu/utils/checkpoint.py"
+
+
+@rule("TPU114", "checkpoint-metric-sole-writer",
+      "The tpu_operator_job_checkpoint* metric prefix is reserved for "
+      "utils/checkpoint.py, the checkpoint durability pipeline.")
+def check_checkpoint_sole_writer(repo: RepoView) -> Iterable[Finding]:
+    for sf, line, kind, name, _ in _metric_registrations(repo):
+        if not name.startswith(_CHECKPOINT_PREFIXES):
+            continue
+        if sf.rel != _CHECKPOINT_OWNER:
+            yield Finding(
+                sf.rel, line, "TPU114",
+                f"{kind}({name!r}): checkpoint metric prefixes are "
+                f"reserved for {_CHECKPOINT_OWNER}",
             )
 
 

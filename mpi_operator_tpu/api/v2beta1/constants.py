@@ -23,8 +23,14 @@ REPLICA_INDEX_LABEL = "training.kubeflow.org/replica-index"
 # Role label values / object-name suffixes (mpi_job_controller.go:104-112).
 ROLE_LAUNCHER = "launcher"
 ROLE_WORKER = "worker"
+# Hot-spare standby workers (spec.tpu.hotSpares): scheduled and
+# bootstrapped like workers but parked before the barrier, so a worker
+# death is repaired by *promotion* (restamp env, pre-bind to the spare's
+# node) instead of the full schedule→pending→bootstrap pipeline.
+ROLE_SPARE = "spare"
 LAUNCHER_SUFFIX = "-launcher"
 WORKER_SUFFIX = "-worker"
+SPARE_SUFFIX = "-spare"
 
 # The TPU resource name requested by worker pods — the analog of the
 # reference blanking nvidia.com/gpu for the launcher (:202-205, :1379-1383);
@@ -60,6 +66,19 @@ ENV_STEP_SLOWDOWN = "TPUJOB_CHAOS_STEP_SLOWDOWN"
 # window, driving the real MemoryPressure detector path without
 # allocating anything.  Unset/0 = no-op.
 ENV_MEM_LEAK_BYTES = "TPU_MEM_LEAK_BYTES"
+
+# Chaos-injected torn checkpoint commit (chaos TornWriteChaos fault →
+# LocalPodRunner child env → utils/checkpoint.AsyncCheckpointManager):
+# the victim's next checkpoint write lands its step data but dies before
+# the commit marker — the on-disk state a writer killed mid-commit
+# leaves behind.  One-shot (the runner pops it after one injection);
+# unset/0 = no-op.
+ENV_TORN_WRITE = "TPUJOB_CHAOS_TORN_WRITE"
+
+# Grace budget (seconds) the preempted final save may spend draining an
+# in-flight async checkpoint write before giving up — kept under the
+# pod's terminationGracePeriodSeconds so SIGKILL never lands mid-commit.
+ENV_CHECKPOINT_GRACE = "TPUJOB_CHECKPOINT_GRACE_S"
 
 # Cross-process trace propagation (W3C traceparent analog): the controller
 # stamps the reconcile's (trace id, span id) into every pod it builds, and
@@ -104,6 +123,16 @@ STEP_HEARTBEAT_ANNOTATION = "tpujob.kubeflow.org/step-heartbeat"
 # (utils/devstats.MemoryMatrix) consumes via the pod informer watch.
 # Value: one JSON object.
 DEVICE_MEMORY_ANNOTATION = "tpujob.kubeflow.org/device-memory"
+
+# Hot-spare bookkeeping.  STANDBY_ANNOTATION marks a parked spare pod
+# ("true"): the scheduler's chip gauges tally standby capacity
+# separately and prefer standby gangs as preemption victims.
+# PROMOTED_FROM_ANNOTATION on a worker records the spare pod whose warm
+# slot it took — the pod is created pre-bound to that spare's node, so
+# it skips the scheduler entirely and restart downtime collapses to
+# rejoin time.
+STANDBY_ANNOTATION = "tpujob.kubeflow.org/standby"
+PROMOTED_FROM_ANNOTATION = "tpujob.kubeflow.org/promoted-from"
 
 # ConfigMap keys (hostfile/discover_hosts.sh analogs,
 # mpi_job_controller.go:1106-1145).

@@ -1110,6 +1110,13 @@ def job_spec_schema() -> dict:
                         minimum=1,
                     ),
                     "runtimeVersion": _str("TPU VM runtime version label."),
+                    "hotSpares": _int(
+                        "Standby workers kept warm (scheduled, "
+                        "bootstrapped, parked before the barrier) for "
+                        "fast promotion when a worker death is "
+                        "restart-eligible.",
+                        minimum=0,
+                    ),
                 },
             },
             "jaxDistribution": {

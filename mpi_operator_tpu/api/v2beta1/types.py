@@ -349,13 +349,18 @@ class TPUSpec:
 
     ``accelerator_type`` is ``<generation>-<chips>`` (e.g. ``v5e-16``);
     ``topology`` optionally pins the slice shape (``4x4``); ``num_slices``
-    > 1 asks for a multislice job (data-parallel over DCN).
+    > 1 asks for a multislice job (data-parallel over DCN);
+    ``hot_spares`` > 0 over-provisions that many standby workers kept
+    warm (scheduled, bootstrapped, parked before the barrier) so a
+    restart-eligible worker death is repaired by promotion instead of
+    the full schedule→pending→bootstrap pipeline.
     """
 
     accelerator_type: str = ""
     topology: str = ""
     num_slices: int = 1
     runtime_version: str = ""
+    hot_spares: int = 0
 
     def to_dict(self) -> dict:
         d: dict[str, Any] = {}
@@ -367,12 +372,15 @@ class TPUSpec:
             d["numSlices"] = self.num_slices
         if self.runtime_version:
             d["runtimeVersion"] = self.runtime_version
+        if self.hot_spares != 0:
+            d["hotSpares"] = self.hot_spares
         return d
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "TPUSpec":
         d = d or {}
         num_slices = d.get("numSlices")
+        hot_spares = d.get("hotSpares")
         return cls(
             accelerator_type=d.get("acceleratorType", ""),
             topology=d.get("topology", ""),
@@ -380,6 +388,9 @@ class TPUSpec:
             # validation can reject it; only absence defaults to 1.
             num_slices=1 if num_slices is None else int(num_slices),
             runtime_version=d.get("runtimeVersion", ""),
+            # Same preservation contract: absence defaults to 0, an
+            # explicit negative survives for validation to reject.
+            hot_spares=0 if hot_spares is None else int(hot_spares),
         )
 
 

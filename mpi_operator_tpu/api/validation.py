@@ -174,6 +174,10 @@ def _validate_tpu(spec: TPUJobSpec, spec_path: str) -> list[FieldError]:
     if tpu.num_slices < 1:
         errs.append(invalid(f"{path}.numSlices", tpu.num_slices, "must be >= 1"))
         return errs
+    if tpu.hot_spares < 0:
+        errs.append(
+            invalid(f"{path}.hotSpares", tpu.hot_spares, "must be >= 0")
+        )
     worker = spec.replica_specs.get(REPLICA_TYPE_WORKER)
     if worker is not None and worker.replicas is not None:
         want = shape.num_hosts * tpu.num_slices

@@ -16,13 +16,14 @@ from .engine import (
     SERVER_ERROR,
     SLOW_WORKER,
     TIMEOUT,
+    TORN_WRITE,
     WATCH_DELAY,
     WATCH_DROP,
     WATCH_GONE,
     ChaosEngine,
     ChaosEvent,
 )
-from .podchaos import LeakInjector, PodKiller, WorkerSlower
+from .podchaos import LeakInjector, PodKiller, TornWriteInjector, WorkerSlower
 from .policy import (
     READ_VERBS,
     WRITE_VERBS,
@@ -30,6 +31,7 @@ from .policy import (
     MemoryLeakChaos,
     PodChaos,
     SlowWorkerChaos,
+    TornWriteChaos,
     VerbFaults,
     WatchFaults,
 )
@@ -43,6 +45,7 @@ __all__ = [
     "SERVER_ERROR",
     "SLOW_WORKER",
     "TIMEOUT",
+    "TORN_WRITE",
     "WATCH_DELAY",
     "WATCH_DROP",
     "WATCH_GONE",
@@ -57,6 +60,8 @@ __all__ = [
     "PodChaos",
     "PodKiller",
     "SlowWorkerChaos",
+    "TornWriteChaos",
+    "TornWriteInjector",
     "VerbFaults",
     "WatchFaults",
     "WorkerSlower",

@@ -27,7 +27,13 @@ from ..runtime.apiserver import (
 )
 from ..runtime import locktrace
 from ..utils.metrics import Registry, new_counter
-from .policy import ChaosPolicy, MemoryLeakChaos, PodChaos, SlowWorkerChaos
+from .policy import (
+    ChaosPolicy,
+    MemoryLeakChaos,
+    PodChaos,
+    SlowWorkerChaos,
+    TornWriteChaos,
+)
 
 # Fault kinds (event-log / metric label vocabulary).
 CONFLICT = "conflict"
@@ -40,6 +46,7 @@ POD_KILL = "pod_kill"
 NODE_DEATH = "node_death"
 SLOW_WORKER = "slow_worker"
 MEM_LEAK = "mem_leak"
+TORN_WRITE = "torn_write"
 
 
 @dataclass(frozen=True)
@@ -66,6 +73,7 @@ class ChaosEngine:
         self._kill_counts: dict[int, int] = {}
         self._slow_counts: dict[int, int] = {}
         self._leak_counts: dict[int, int] = {}
+        self._torn_counts: dict[int, int] = {}
         self.faults_total = new_counter(
             "tpu_operator_chaos_faults_injected_total",
             "Faults injected by the chaos engine, by kind.",
@@ -87,6 +95,13 @@ class ChaosEngine:
             "tpu_operator_chaos_pod_leaks_total",
             "Workers given an injected HBM leak by the chaos engine "
             "(MemoryLeak faults).",
+            registry=registry,
+        )
+        self.pod_torn_writes_total = new_counter(
+            "tpu_operator_chaos_pod_torn_writes_total",
+            "Workers killed mid-checkpoint-commit by the chaos engine "
+            "(TornWrite faults: step data persisted, commit marker "
+            "withheld).",
             registry=registry,
         )
 
@@ -250,3 +265,31 @@ class ChaosEngine:
             MEM_LEAK, f"pod {key}", f"bytes_per_window={bytes_per_window}"
         )
         self.pod_leaks_total.inc(1.0)
+
+    # -- torn checkpoint commits -----------------------------------------
+
+    def torn_fault(
+        self, policy_index: int, policy: TornWriteChaos
+    ) -> bool:
+        """Decide one (policy, pod, tick)'s fate: tear the worker's next
+        checkpoint commit or not.  One draw per decision (the determinism
+        contract); a landed tear must be reported via confirm_torn so the
+        max_torn budget counts only victims that actually got armed."""
+        if policy.torn_rate <= 0.0:
+            return False
+        if policy.max_torn:
+            with self._lock:
+                if (
+                    self._torn_counts.get(policy_index, 0)
+                    >= policy.max_torn
+                ):
+                    return False
+        return self.roll() < policy.torn_rate
+
+    def confirm_torn(self, policy_index: int, key: str) -> None:
+        with self._lock:
+            self._torn_counts[policy_index] = (
+                self._torn_counts.get(policy_index, 0) + 1
+            )
+        self.record(TORN_WRITE, f"pod {key}")
+        self.pod_torn_writes_total.inc(1.0)

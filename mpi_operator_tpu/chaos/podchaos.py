@@ -14,9 +14,16 @@ import threading
 
 from ..api.v2beta1.constants import JOB_NAME_LABEL, JOB_ROLE_LABEL
 from ..runtime.apiserver import InMemoryAPIServer
-from .engine import MEM_LEAK, NODE_DEATH, POD_KILL, SLOW_WORKER, ChaosEngine
+from .engine import (
+    MEM_LEAK,
+    NODE_DEATH,
+    POD_KILL,
+    SLOW_WORKER,
+    TORN_WRITE,
+    ChaosEngine,
+)
 
-__all__ = ["LeakInjector", "PodKiller", "WorkerSlower"]
+__all__ = ["LeakInjector", "PodKiller", "TornWriteInjector", "WorkerSlower"]
 
 
 def _record_fault(
@@ -178,6 +185,89 @@ class WorkerSlower:
         self._thread = threading.Thread(
             target=self._loop, args=(interval,), daemon=True,
             name="chaos-workerslower",
+        )
+        self._thread.start()
+
+    def _loop(self, interval: float) -> None:
+        while not self._stop.is_set():
+            self.tick()
+            self._stop.wait(interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class TornWriteInjector:
+    """TornWrite chaos: each tick gives every matching running worker one
+    seeded draw deciding whether it dies mid-checkpoint-commit.  A landed
+    fault arms a one-shot torn commit (``runner.tear_write``: at the
+    victim's next (re)start its writer persists the step data but
+    withholds the commit marker) and then SIGKILLs the current process
+    (``runner.kill_pod``, exit 137 — the preemption signature), so the
+    replacement worker both produces the torn write and later has to
+    restore around one.  Same pacing contract as PodKiller: a thread in
+    live soaks, explicit ``tick()`` calls in deterministic replays.
+
+    With a flight recorder wired, every landed tear also lands on the
+    victim job's timeline as a ``torn_write`` entry.
+    """
+
+    def __init__(
+        self,
+        engine: ChaosEngine,
+        api: InMemoryAPIServer,
+        runner,
+        flight_recorder=None,
+    ):
+        self._engine = engine
+        self._api = getattr(api, "inner", api)
+        self._runner = runner
+        self._recorder = flight_recorder
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def tick(self) -> int:
+        """One chaos round; returns the number of tears that landed."""
+        torn = 0
+        for index, policy in enumerate(self._engine.policy.torn):
+            if policy.torn_rate <= 0.0:
+                continue
+            pods = self._api.list("pods", policy.namespace or None)
+            for pod in pods:
+                if (pod.get("status") or {}).get("phase") != "Running":
+                    continue
+                meta = pod.get("metadata") or {}
+                labels = meta.get("labels") or {}
+                role = labels.get(JOB_ROLE_LABEL, "")
+                if policy.roles and role not in policy.roles:
+                    continue
+                key = (meta.get("namespace", ""), meta.get("name", ""))
+                if not self._engine.torn_fault(index, policy):
+                    continue
+                if self._runner.tear_write(key[0], key[1]):
+                    # Kill after arming: the death is the fault being
+                    # modelled; the armed tear reaches the replacement.
+                    self._runner.kill_pod(key[0], key[1])
+                    self._engine.confirm_torn(index, f"{key[0]}/{key[1]}")
+                    _record_fault(
+                        self._recorder, meta, TORN_WRITE,
+                        "killed mid-commit (marker withheld)",
+                    )
+                    torn += 1
+        return torn
+
+    # -- background pacing (live soaks) ---------------------------------
+
+    def start(self, interval: float = 0.05) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, args=(interval,), daemon=True,
+            name="chaos-tornwriteinjector",
         )
         self._thread.start()
 

@@ -173,6 +173,32 @@ class MemoryLeakChaos:
 
 
 @dataclass(frozen=True)
+class TornWriteChaos:
+    """Torn-checkpoint injection: a matching running worker's *next*
+    checkpoint commit is torn — the step data reaches disk but the commit
+    marker never lands — and the worker is killed at that moment.
+
+    Models the exact death window the commit-marker protocol
+    (utils/checkpoint.py) exists to survive: a preemption between the
+    fsync of the checkpoint payload and the atomic rename that publishes
+    it.  Restore must skip the uncommitted newest step and fall back to
+    an older committed one, not crash on (or worse, trust) a torn write.
+    """
+
+    torn_rate: float = 0.0
+    roles: tuple[str, ...] = (ROLE_WORKER,)
+    namespace: str = ""  # "" = every namespace
+    max_torn: int = 0  # 0 = unlimited
+
+    def __post_init__(self) -> None:
+        _check_rate("torn_rate", self.torn_rate)
+        if self.max_torn < 0:
+            raise ValueError(
+                f"max_torn must be >= 0, got {self.max_torn!r}"
+            )
+
+
+@dataclass(frozen=True)
 class ChaosPolicy:
     """One replayable chaos run: seed + the active fault policies."""
 
@@ -182,6 +208,7 @@ class ChaosPolicy:
     pods: tuple[PodChaos, ...] = ()
     slow: tuple[SlowWorkerChaos, ...] = ()
     leak: tuple[MemoryLeakChaos, ...] = ()
+    torn: tuple[TornWriteChaos, ...] = ()
 
     def verb_policy(self, verb: str, resource: str) -> Optional[VerbFaults]:
         """First policy matching (verb, resource); None = no faults."""

@@ -76,8 +76,27 @@ def worker_selector(job_name: str) -> dict[str, str]:
     return default_labels(job_name, constants.ROLE_WORKER)
 
 
+def spare_selector(job_name: str) -> dict[str, str]:
+    return default_labels(job_name, constants.ROLE_SPARE)
+
+
 def worker_name(job: TPUJob, index: int) -> str:
     return f"{job.name}{constants.WORKER_SUFFIX}-{index}"
+
+
+def spare_name(job: TPUJob, index: int) -> str:
+    return f"{job.name}{constants.SPARE_SUFFIX}-{index}"
+
+
+def spare_group_name(job: TPUJob) -> str:
+    # The spares form their OWN gang: the worker gang must never wait on
+    # standby capacity, and the scheduler can evict the spare gang as the
+    # cheapest preemption victim without decapitating the workers.
+    return job.name + constants.SPARE_SUFFIX
+
+
+def hot_spares(job: TPUJob) -> int:
+    return max(getattr(job.spec.tpu, "hot_spares", 0) or 0, 0)
 
 
 def workers_service_name(job: TPUJob) -> str:
@@ -381,6 +400,109 @@ def new_pod_group(job: TPUJob, min_member: int) -> KubeObject:
         "PodGroup",
         ObjectMeta(
             name=job.name,
+            namespace=job.namespace,
+            owner_references=[OwnerReference.from_dict(controller_ref(job))],
+        ),
+        spec=spec,
+    )
+
+
+@_traced("builders.new_spare")
+def new_spare(job: TPUJob, index: int, gang_scheduler_name: str = "") -> KubeObject:
+    """Hot-spare standby Pod (spec.tpu.hotSpares).
+
+    Same template, node shape, and chip footprint as a worker — it must be
+    schedulable anywhere a worker is — but it runs the ``park`` launcher
+    instead of the user command, so it bootstraps (image pulled, runtime
+    warm) and then blocks *before* the collective barrier. Promotion turns
+    its reserved node into a pre-bound replacement worker, skipping
+    schedule->pending->bootstrap entirely.
+    """
+    shape = slice_shape(job)
+    template = copy.deepcopy(job.spec.replica_specs[REPLICA_TYPE_WORKER].template)
+    pod_spec = template.setdefault("spec", {})
+    tmeta = template.setdefault("metadata", {})
+
+    labels = dict(tmeta.get("labels") or {})
+    labels.update(default_labels(job.name, constants.ROLE_SPARE))
+    labels[constants.REPLICA_INDEX_LABEL] = str(index)
+    annotations = dict(tmeta.get("annotations") or {})
+    annotations[constants.STANDBY_ANNOTATION] = "true"
+    annotations[constants.WORLD_SIZE_ANNOTATION] = str(worker_replicas(job))
+
+    name = spare_name(job, index)
+    pod_spec["hostname"] = name
+    pod_spec["subdomain"] = workers_service_name(job)
+    if pod_spec.get("hostNetwork"):
+        pod_spec["dnsPolicy"] = "ClusterFirstWithHostNet"
+    pod_spec["restartPolicy"] = "Never"
+
+    containers = pod_spec.get("containers") or [{}]
+    container = containers[0]
+    # A spare must never start training: the user command is replaced with
+    # the parking loop unconditionally. The rendezvous env is *not* stamped
+    # here — the promoted replacement worker is a fresh pod whose env is
+    # restamped by new_worker at promotion time.
+    container["command"] = ["python", "-m", "mpi_operator_tpu.launcher.park"]
+    container.pop("args", None)
+    container.setdefault("env", [])
+    container["env"] = list(container["env"]) + [
+        {"name": constants.ENV_TPU_ACCELERATOR_TYPE, "value": shape.accelerator_type},
+        {"name": constants.ENV_TPU_TOPOLOGY, "value": shape.topology},
+        {"name": constants.ENV_TPU_CHIPS_PER_HOST, "value": str(shape.chips_per_host)},
+        {"name": constants.ENV_JOB_NAME, "value": job.name},
+        {"name": constants.ENV_JOB_NAMESPACE, "value": job.namespace},
+    ]
+    # Full chip footprint: the spare *holds* a worker-shaped node so the
+    # promoted pod can bind to it without a scheduling pass.
+    resources = container.setdefault("resources", {})
+    for bound in ("limits", "requests"):
+        section = resources.setdefault(bound, {})
+        section.setdefault(constants.TPU_RESOURCE_NAME, shape.chips_per_host)
+    pod_spec["containers"] = containers
+
+    if gang_scheduler_name:
+        pod_spec["schedulerName"] = gang_scheduler_name
+        annotations["scheduling.k8s.io/group-name"] = spare_group_name(job)
+
+    meta = ObjectMeta(
+        name=name,
+        namespace=job.namespace,
+        labels=labels,
+        annotations=annotations,
+        owner_references=[OwnerReference.from_dict(controller_ref(job))],
+    )
+    return KubeObject("v1", "Pod", meta, spec=pod_spec)
+
+
+@_traced("builders.new_spare_group")
+def new_spare_group(job: TPUJob) -> KubeObject:
+    """PodGroup for the spare gang.
+
+    Inherits the job's priorityClassName so a high-priority job pre-reserves
+    standby capacity at its own priority; minMember is the spare count (the
+    worker gang never waits on spares).
+    """
+    priority_class = ""
+    for rtype in (REPLICA_TYPE_LAUNCHER, REPLICA_TYPE_WORKER):
+        rspec = job.spec.replica_specs.get(rtype)
+        if rspec is not None:
+            priority_class = (rspec.template.get("spec") or {}).get(
+                "priorityClassName", ""
+            )
+            if priority_class:
+                break
+    sp = job.spec.run_policy.scheduling_policy
+    if sp is not None and sp.priority_class:
+        priority_class = sp.priority_class
+    spec: dict = {"minMember": hot_spares(job)}
+    if priority_class:
+        spec["priorityClassName"] = priority_class
+    return KubeObject(
+        "scheduling.x-k8s.io/v1alpha1",
+        "PodGroup",
+        ObjectMeta(
+            name=spare_group_name(job),
             namespace=job.namespace,
             owner_references=[OwnerReference.from_dict(controller_ref(job))],
         ),

@@ -183,6 +183,11 @@ class TPUJobController:
             "tpu_operator_jobs_failed_total", "Counts number of TPU jobs failed",
             registry=registry,
         )
+        self.spare_promotions = metrics.new_counter(
+            "tpu_operator_spare_promotions_total",
+            "Hot-spare standby pods promoted into the worker gang",
+            registry=registry,
+        )
         # Reconcile observability: where sync time goes, what fails, and
         # when each job condition last flipped.
         self.sync_duration = metrics.new_histogram(
@@ -534,6 +539,10 @@ class TPUJobController:
 
         # Finished & stamped: clean up per cleanPodPolicy (:504-520).
         if st.is_finished(job.status) and job.status.completion_time is not None:
+            # Spares go unconditionally: a parked standby is pure held
+            # capacity with no diagnostic value, so no cleanPodPolicy
+            # setting justifies keeping one after the job finishes.
+            self._delete_spare_pods(job)
             if job.spec.run_policy.clean_pod_policy in ("Running", "All"):
                 self._delete_worker_pods(job)
                 # Unlike the reference (:516-518, which wipes the whole
@@ -573,6 +582,12 @@ class TPUJobController:
             if self.gang_scheduler_name:
                 min_member = builders.worker_replicas(job) + (1 if has_launcher_spec else 0)
                 self._get_or_create_pod_group(job, min_member)
+                if builders.hot_spares(job) > 0:
+                    self._get_or_create_spare_pod_group(job)
+            # Spares before workers: a standby promoted away last sync is
+            # backfilled here, off the critical path, before the worker
+            # loop looks for the next promotion candidate.
+            self._get_or_create_spares(job)
             workers = self._get_or_create_workers(job)
             if has_launcher_spec and launcher is None:
                 with self.profiler.phase(profiling.PHASE_RENDER):
@@ -774,19 +789,48 @@ class TPUJobController:
             raise RuntimeError(f"PodGroup {job.name} not controlled by us")
         return existing
 
-    def _delete_pod_groups(self, job: TPUJob) -> None:
-        """deletePodGroups :641-667 analog."""
-        existing = self.podgroup_informer.lister.get(job.namespace, job.name)
+    def _get_or_create_spare_pod_group(self, job: TPUJob) -> dict:
+        """PodGroup for the spare gang (own group so the worker gang never
+        waits on standby capacity; see builders.spare_group_name)."""
+        name = builders.spare_group_name(job)
+        with self.profiler.phase(profiling.PHASE_CACHE_READ):
+            existing = self.podgroup_informer.lister.get(job.namespace, name)
         if existing is None:
-            return
+            with self.profiler.phase(profiling.PHASE_RENDER):
+                desired = builders.new_spare_group(job)
+            try:
+                with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                    return (
+                        self.scheduling.podgroups(job.namespace)
+                        .create(desired)
+                        .to_dict()
+                    )
+            except AlreadyExistsError:  # stale cache; see _get_or_create_service
+                existing = self._read_through_adopt(
+                    self.scheduling.podgroups(job.namespace), job, name,
+                    recreate=lambda: self.scheduling.podgroups(job.namespace)
+                    .create(builders.new_spare_group(job))
+                    .to_dict(),
+                )
         if not is_controlled_by(existing, job):
             self._flag_not_controlled(job, existing)
-            raise RuntimeError(f"PodGroup {job.name} not controlled by us")
-        try:
-            with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
-                self.scheduling.podgroups(job.namespace).delete(job.name)
-        except NotFoundError:
-            pass
+            raise RuntimeError(f"PodGroup {name} not controlled by us")
+        return existing
+
+    def _delete_pod_groups(self, job: TPUJob) -> None:
+        """deletePodGroups :641-667 analog (worker gang + spare gang)."""
+        for name in (job.name, builders.spare_group_name(job)):
+            existing = self.podgroup_informer.lister.get(job.namespace, name)
+            if existing is None:
+                continue
+            if not is_controlled_by(existing, job):
+                self._flag_not_controlled(job, existing)
+                raise RuntimeError(f"PodGroup {name} not controlled by us")
+            try:
+                with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                    self.scheduling.podgroups(job.namespace).delete(name)
+            except NotFoundError:
+                pass
 
     def _list_worker_pods(self, job: TPUJob) -> list[dict]:
         with self.profiler.phase(profiling.PHASE_CACHE_READ):
@@ -797,6 +841,155 @@ class TPUJobController:
     def _running_worker_pods(self, job: TPUJob) -> list[dict]:
         """getRunningWorkerPods :670-688 analog."""
         return [p for p in self._list_worker_pods(job) if _pod_phase(p) == POD_RUNNING]
+
+    def _list_spare_pods(self, job: TPUJob) -> list[dict]:
+        with self.profiler.phase(profiling.PHASE_CACHE_READ):
+            return self.pod_informer.lister.list(
+                job.namespace, builders.spare_selector(job.name)
+            )
+
+    def _get_or_create_spares(self, job: TPUJob) -> list[dict]:
+        """Keep spec.tpu.hotSpares standby pods warm (incl. scale-down of
+        index >= hotSpares and backfill of promoted-away spares).
+
+        A spare that *fails* is simply replaced — standby restarts never
+        charge runPolicy.backoffLimit, because a dead spare costs the job
+        nothing (it was never in the gang).
+        """
+        out: list[dict] = []
+        spares = builders.hot_spares(job)
+
+        existing = self._list_spare_pods(job)
+        for pod in existing:
+            index_str = (pod["metadata"].get("labels") or {}).get(
+                constants.REPLICA_INDEX_LABEL
+            )
+            try:
+                index = int(index_str) if index_str is not None else -1
+            except ValueError:
+                index = -1
+            if index >= spares or index < 0:
+                try:
+                    with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                        self.kube.pods(job.namespace).delete(
+                            pod["metadata"]["name"]
+                        )
+                except NotFoundError:
+                    pass
+
+        for k in range(spares):
+            name = builders.spare_name(job, k)
+            with self.profiler.phase(profiling.PHASE_CACHE_READ):
+                pod = self.pod_informer.lister.get(job.namespace, name)
+            if pod is not None and is_controlled_by(pod, job):
+                if _pod_phase(pod) in (POD_FAILED, POD_SUCCEEDED):
+                    # A spare never completes on purpose (the park loop
+                    # only exits on SIGTERM); either phase means it must
+                    # be re-armed.
+                    try:
+                        with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                            self.kube.pods(job.namespace).delete(name)
+                    except NotFoundError:
+                        pass
+                    pod = None
+            if pod is None:
+                with self.profiler.phase(profiling.PHASE_RENDER):
+                    desired_pod = builders.new_spare(
+                        job, k, self.gang_scheduler_name
+                    )
+                try:
+                    with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                        pod = (
+                            self.kube.pods(job.namespace)
+                            .create(desired_pod)
+                            .to_dict()
+                        )
+                except AlreadyExistsError:
+                    # Stale cache (see _get_or_create_service docstring).
+                    pod = self._read_through_adopt(
+                        self.kube.pods(job.namespace), job, name,
+                        recreate=lambda k=k: self.kube.pods(job.namespace)
+                        .create(builders.new_spare(
+                            job, k, self.gang_scheduler_name
+                        ))
+                        .to_dict(),
+                    )
+            if not is_controlled_by(pod, job):
+                self._flag_not_controlled(job, pod)
+                raise RuntimeError(f"spare Pod {name} not controlled by us")
+            out.append(pod)
+        return out
+
+    def _promote_spare(self, job: TPUJob, desired_pod: KubeObject) -> Optional[str]:
+        """Promote a warm standby into ``desired_pod``'s seat.
+
+        Picks a Running, node-bound spare; deletes it (freeing its chips
+        on that node) and pre-binds the replacement worker to the same
+        node via spec.nodeName — the gang scheduler skips pre-bound pods
+        (_wants), so the replacement goes straight to the kubelet and
+        restart_downtime collapses to process-rejoin time. Returns the
+        promoted spare's pod name, or None when no spare is ready (the
+        replacement then takes the ordinary schedule->pending->bootstrap
+        path).
+        """
+        for spare in sorted(
+            self._list_spare_pods(job), key=lambda p: p["metadata"]["name"]
+        ):
+            if _pod_phase(spare) != POD_RUNNING:
+                continue
+            if not is_controlled_by(spare, job):
+                continue
+            node = (spare.get("spec") or {}).get("nodeName", "")
+            if not node:
+                continue
+            sname = spare["metadata"]["name"]
+            try:
+                with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                    self.kube.pods(job.namespace).delete(sname)
+            except NotFoundError:
+                continue  # raced away; try the next spare
+            # Pre-bind onto the promoted spare's still-warm node: this is
+            # the one sanctioned nodeName write outside the scheduler —
+            # the chips were already charged to the spare on that exact
+            # node, and the scheduler skips pre-bound pods (_wants).
+            desired_pod.spec["nodeName"] = node  # noqa: TPU303
+            desired_pod.metadata.annotations[
+                constants.PROMOTED_FROM_ANNOTATION
+            ] = sname
+            self.spare_promotions.inc()
+            self.flight_recorder.record(
+                job.namespace,
+                job.name,
+                flightrecorder.POD,
+                reason="SparePromoted",
+                message=f"promoted standby {sname} on node {node} as "
+                        f"{desired_pod.name}",
+                pod=desired_pod.name,
+                node=node,
+                spare=sname,
+            )
+            self.recorder.eventf(
+                job,
+                EVENT_TYPE_NORMAL,
+                "SparePromoted",
+                "promoted standby %s onto node %s as %s",
+                sname,
+                node,
+                desired_pod.name,
+            )
+            return sname
+        return None
+
+    def _delete_spare_pods(self, job: TPUJob) -> None:
+        for pod in self._list_spare_pods(job):
+            if is_controlled_by(pod, job):
+                try:
+                    with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
+                        self.kube.pods(job.namespace).delete(
+                            pod["metadata"]["name"]
+                        )
+                except NotFoundError:
+                    pass
 
     def _get_or_create_workers(self, job: TPUJob) -> list[dict]:
         """getOrCreateWorker :798-853 analog, incl. scale-down deletion of
@@ -844,6 +1037,9 @@ class TPUJobController:
             return backoff is None or wstatus.restarts < backoff
 
         restarted: list[str] = []
+        # Worker names whose replacement this sync is restart-driven —
+        # exactly the seats a hot spare may be promoted into.
+        promotable: set[str] = set()
 
         def delete_for_restart(name: str, reason: str) -> None:
             """Shared restart bookkeeping for the cached and the
@@ -857,6 +1053,7 @@ class TPUJobController:
             if reason.startswith("failed"):
                 wstatus.restarts += 1  # counts against backoffLimit
             restarted.append(f"{name} ({reason})")
+            promotable.add(name)
 
         for i in range(replicas):
             name = builders.worker_name(job, i)
@@ -898,6 +1095,8 @@ class TPUJobController:
                     desired_pod = builders.new_worker(
                         job, i, self.gang_scheduler_name
                     )
+                if name in promotable:
+                    self._promote_spare(job, desired_pod)
                 try:
                     with self.profiler.phase(profiling.PHASE_APISERVER_WRITE):
                         pod = (
@@ -926,11 +1125,13 @@ class TPUJobController:
                     )
                     if reason is not None:
                         delete_for_restart(name, reason)
+                        replacement = builders.new_worker(
+                            job, i, self.gang_scheduler_name
+                        )
+                        self._promote_spare(job, replacement)
                         pod = (
                             self.kube.pods(job.namespace)
-                            .create(builders.new_worker(
-                                job, i, self.gang_scheduler_name
-                            ))
+                            .create(replacement)
                             .to_dict()
                         )
                 except Exception as e:
@@ -1063,8 +1264,10 @@ class TPUJobController:
                 pass
 
     def _suspend(self, job: TPUJob, old_status: Optional[dict] = None) -> None:
-        """Suspension: tear down workers + launcher, keep Service/ConfigMap."""
+        """Suspension: tear down workers + spares + launcher, keep
+        Service/ConfigMap."""
         self._delete_worker_pods_all(job)
+        self._delete_spare_pods(job)
         launcher = self.job_informer.lister.get(job.namespace, builders.launcher_name(job))
         if launcher is not None and is_controlled_by(launcher, job):
             try:

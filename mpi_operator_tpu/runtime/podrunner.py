@@ -105,6 +105,12 @@ class LocalPodRunner:
         # worker's devstats sampler inflates its reported HBM; same
         # next-(re)start semantics as _slow.
         self._leak: dict[tuple[str, str], int] = {}
+        # Chaos TornWrite registrations: pod key -> True, injected ONCE
+        # into the child env (ENV_TORN_WRITE) so the checkpoint writer
+        # tears its next commit (step data written, marker withheld);
+        # one-shot — the entry is popped at injection, so a restarted pod
+        # writes clean checkpoints again.
+        self._torn: dict[tuple[str, str], bool] = {}
         self._job_pods: dict[tuple[str, str], int] = {}  # job -> failures so far
         self._lock = locktrace.rlock("podrunner")
         self._stop = threading.Event()
@@ -194,6 +200,8 @@ class LocalPodRunner:
         leak = self._leak.get(self._event_key(pod))
         if leak is not None and leak > 0:
             env[constants.ENV_MEM_LEAK_BYTES] = str(leak)
+        if self._torn.pop(self._event_key(pod), None):
+            env[constants.ENV_TORN_WRITE] = "1"
         container = (pod["spec"].get("containers") or [{}])[0]
         for item in container.get("env") or []:
             value = str(item.get("value", ""))
@@ -439,6 +447,15 @@ class LocalPodRunner:
         return self._register_chaos(
             self._leak, namespace, name, int(bytes_per_window)
         )
+
+    def tear_write(self, namespace: str, name: str) -> bool:
+        """Chaos hook: arm a one-shot torn checkpoint commit for the
+        pod's *next* (re)start — the writer persists the step data but
+        withholds the commit marker (ENV_TORN_WRITE), modelling a death
+        between the fsync of the data and the rename of the marker.
+        Same next-(re)start semantics as slow_worker; the registration
+        is consumed at injection (one torn commit per arm)."""
+        return self._register_chaos(self._torn, namespace, name, True)
 
     def _register_chaos(
         self, table: dict, namespace: str, name: str, value

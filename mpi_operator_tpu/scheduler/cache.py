@@ -14,9 +14,17 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..api.v2beta1 import constants as api_constants
 from . import inventory
 
 PodKey = tuple[str, str]  # (namespace, name)
+
+
+def is_standby_pod(pod: dict) -> bool:
+    """Parked hot-spare pod (spec.tpu.hotSpares): holds chips that are
+    charged as allocated but are reclaimable by promotion or preemption."""
+    annotations = (pod.get("metadata") or {}).get("annotations") or {}
+    return annotations.get(api_constants.STANDBY_ANNOTATION) == "true"
 
 
 def pod_chips(pod: dict) -> int:
@@ -47,6 +55,7 @@ class NodeInfo:
     host_index: int = 0
     allocated: int = 0  # chips of bound, non-terminal pods
     reserved: int = 0  # chips of in-flight gang reservations
+    standby: int = 0  # subset of allocated held by parked hot-spare pods
     labels: dict = field(default_factory=dict)
 
     @property
@@ -95,6 +104,7 @@ class SchedulerCache:
             # Keep the ledger: only refresh the static identity fields.
             node.allocated = existing.allocated
             node.reserved = existing.reserved
+            node.standby = existing.standby
         self.nodes[node.name] = node
 
     def remove_node(self, name: str) -> None:
@@ -169,6 +179,7 @@ class SchedulerCache:
         without a watch stream."""
         for node in self.nodes.values():
             node.allocated = 0
+            node.standby = 0
         self._bound.clear()
         present: set[PodKey] = set()
         for pod in pods:
@@ -183,6 +194,10 @@ class SchedulerCache:
             node = self.nodes.get(node_name)
             if node is not None:
                 node.allocated += chips
+                if is_standby_pod(pod):
+                    # Informational tally (rebuilt every pass): standby
+                    # chips are inside `allocated`, never double-counted.
+                    node.standby += chips
                 self._bound[key] = (node_name, chips)
         for key in [k for k in self._reserved if k not in present or k in self._bound]:
             self.release(key)
@@ -197,6 +212,9 @@ class SchedulerCache:
 
     def total_reserved(self) -> int:
         return sum(n.reserved for n in self.nodes.values())
+
+    def total_standby(self) -> int:
+        return sum(n.standby for n in self.nodes.values())
 
     def total_free(self) -> int:
         return sum(n.free for n in self.nodes.values())

@@ -36,7 +36,7 @@ from ..utils import flightrecorder, metrics, profiling
 from ..utils.logging import get_logger
 from ..runtime import locktrace
 from .binder import Binder, BindError
-from .cache import NodeInfo, PodKey, SchedulerCache, pod_chips
+from .cache import NodeInfo, PodKey, SchedulerCache, is_standby_pod, pod_chips
 from .plugins import (
     DEFAULT_PLUGINS,
     Plugin,
@@ -122,7 +122,8 @@ class GangScheduler:
         self.chips = metrics.new_gauge(
             "tpu_operator_scheduler_chips",
             "TPU chips in the scheduler cache by accounting state "
-            "(capacity, allocated, reserved, free).",
+            "(capacity, allocated, reserved, free, standby; standby is a "
+            "subset of allocated held by parked hot-spare pods).",
             ("state",),
             registry,
         )
@@ -162,6 +163,7 @@ class GangScheduler:
                 "allocated": self.cache.total_allocated(),
                 "reserved": self.cache.total_reserved(),
                 "free": self.cache.total_free(),
+                "standby": self.cache.total_standby(),
             }
         for state, value in totals.items():
             self.chips.set(value, state)
@@ -214,9 +216,16 @@ class GangScheduler:
         members = self._gang_sizes(all_pods)
         bound_pods = 0
         still_pending = 0
+        # Standby (hot-spare) gangs sort behind every live gang of the same
+        # priority: spares warm capacity, they must never delay real work.
         order = sorted(
             gangs,
-            key=lambda g: (-self._gang_priority(g), self._first_seen.get(g, now), g),
+            key=lambda g: (
+                -self._gang_priority(g),
+                1 if self._is_standby_gang(gangs[g]) else 0,
+                self._first_seen.get(g, now),
+                g,
+            ),
         )
         for gang_key in order:
             pods = gangs[gang_key]
@@ -267,6 +276,12 @@ class GangScheduler:
         if (pod.get("metadata") or {}).get("deletionTimestamp"):
             return False
         return spec.get("schedulerName", "") in ("", self.scheduler_name)
+
+    @staticmethod
+    def _is_standby_gang(pods: list[dict]) -> bool:
+        """A gang made entirely of parked hot-spare pods (the controller
+        puts spares in their own PodGroup, so mixed gangs don't occur)."""
+        return bool(pods) and all(is_standby_pod(p) for p in pods)
 
     def _gang_sizes(self, all_pods: list[dict]) -> dict[tuple[str, str], int]:
         """Live member count per gang, bound members included."""
@@ -420,13 +435,16 @@ class GangScheduler:
             vkey = gang_of(pod)
             if vkey != gang_key:
                 victims.setdefault(vkey, []).append(pod)
+        # Victim order: cheapest priority first, and within a priority band
+        # standby gangs go before live gangs — evicting parked spares costs
+        # zero training progress.
         candidates = sorted(
             (
                 (self._gang_priority(vk), vk, vpods)
                 for vk, vpods in victims.items()
                 if self._gang_priority(vk) < my_priority
             ),
-            key=lambda t: (t[0], t[1]),
+            key=lambda t: (t[0], 0 if self._is_standby_gang(t[2]) else 1, t[1]),
         )
         if not candidates:
             return None

@@ -40,7 +40,11 @@ POD = "pod"
 SLOW_WORKER = "slow_worker"
 MEM_LEAK = "mem_leak"
 MEMORY = "memory"
-KINDS = (CONDITION, EVENT, SCHEDULING, POD, SLOW_WORKER, MEM_LEAK, MEMORY)
+TORN_WRITE = "torn_write"
+KINDS = (
+    CONDITION, EVENT, SCHEDULING, POD, SLOW_WORKER, MEM_LEAK, MEMORY,
+    TORN_WRITE,
+)
 
 
 class FlightRecorder:

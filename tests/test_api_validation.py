@@ -222,3 +222,27 @@ class TestGPUInAuxContainers:
         }
         errs = validate_tpujob(job)
         assert any("initContainers" in e.field for e in errs)
+
+
+class TestHotSpares:
+    def test_hot_spares_valid(self):
+        job = valid_job()
+        job.spec.tpu.hot_spares = 2
+        assert validate_tpujob(job) == []
+
+    def test_negative_hot_spares_rejected(self):
+        job = valid_job()
+        job.spec.tpu.hot_spares = -1
+        errs = validate_tpujob(job)
+        assert "spec.tpu.hotSpares" in fields(errs)
+
+    def test_hot_spares_round_trips_through_dict(self):
+        job = valid_job()
+        job.spec.tpu.hot_spares = 3
+        d = job.to_dict()
+        assert d["spec"]["tpu"]["hotSpares"] == 3
+        assert TPUJob.from_dict(d).spec.tpu.hot_spares == 3
+        # Zero is the default and stays off the wire.
+        bare = valid_job().to_dict()
+        assert "hotSpares" not in bare["spec"]["tpu"]
+        assert TPUJob.from_dict(bare).spec.tpu.hot_spares == 0

@@ -1,30 +1,47 @@
 """Smoke tier for the goodput-under-preemption benchmark
 (bench_goodput.py).
 
-The full acceptance run (100 jobs x kill rates 0/0.1/0.3) is `make
-bench-goodput`; the tier-1 smoke keeps the harness honest on every run:
-a small fleet must converge at every kill rate, the artifact must pass
-its own schema gate, the per-phase attribution must tile the wall clock
-within 1%, goodput must not *improve* under preemption, and the same
-seed must reproduce the document bit-for-bit.
+The full acceptance run (100 jobs x kill rates 0/0.1/0.3 x resilience
+arms) is `make bench-goodput`; the tier-1 smoke keeps the harness honest
+on every run: a small fleet must converge at every (arm, rate), the
+artifact must pass its own schema gate, the per-phase attribution must
+tile the wall clock within 1%, goodput must not *improve* under
+preemption, the resilient arm must actually promote spares and keep its
+checkpoint tax flat, and the same seed must reproduce the document
+bit-for-bit.  The committed BENCH_GOODPUT.json is itself checked
+against the PR-20 acceptance bars.
 """
 
+import copy
 import json
+import os
 
 import pytest
 
 import bench_goodput as bench
 from mpi_operator_tpu.utils import goodput
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def doc24():
+    """One small two-arm curve shared by every shape assertion below —
+    the sims dominate this module's wall time, so build once."""
+    return bench.build_doc([0.0, 0.3], jobs=24, seed=7)
+
 
 class TestBenchGoodputSmoke:
-    def test_curve_converges_and_schema_checks(self):
-        doc = bench.build_doc([0.0, 0.1, 0.3], jobs=40, seed=7)
+    def test_curve_converges_and_schema_checks(self, doc24):
+        doc = doc24
         bench.check_schema(doc)  # raises on any shape violation
-        assert [p["kill_rate"] for p in doc["curve"]] == [0.0, 0.1, 0.3]
+        assert [(p["arm"], p["kill_rate"]) for p in doc["curve"]] == [
+            ("sync", 0.0), ("sync", 0.3),
+            ("resilient", 0.0), ("resilient", 0.3),
+        ]
         for result in doc["results"]:
             assert result["converged"] is True
-            assert result["outcomes"].get("Succeeded", 0) == 40
+            assert result["outcomes"].get("Succeeded", 0) == 24
             # Phase attribution tiles the fleet wall clock within 1%.
             attributed = sum(result["phase_seconds"].values())
             assert attributed == pytest.approx(
@@ -32,49 +49,176 @@ class TestBenchGoodputSmoke:
                 rel=0.01,
             )
             assert result["attribution_residual_ratio"] <= 0.01
-        # Goodput under preemption never beats the undisturbed baseline.
-        ratios = [p["goodput_ratio"] for p in doc["curve"]]
-        assert ratios[0] >= ratios[-1]
+        # Goodput under preemption never beats the undisturbed baseline,
+        # per arm.
+        for arm in doc["arms"]:
+            ratios = [
+                p["goodput_ratio"] for p in doc["curve"] if p["arm"] == arm
+            ]
+            assert ratios[0] >= ratios[-1]
+
+    def test_chaos_fired_and_attributed(self, doc24):
         # Chaos actually fired at the non-zero rates, and the phase
         # taxonomy shows where the time went.
-        chaotic = doc["results"][-1]
-        assert chaotic["kills"] > 0 and chaotic["restarts_total"] > 0
-        assert chaotic["phase_seconds"][goodput.PHASE_RESTART_DOWNTIME] > 0
-        assert chaotic["loss_attribution_vs_baseline"][
-            goodput.PHASE_RESTART_DOWNTIME
-        ] > 0
+        for arm in doc24["arms"]:
+            chaotic = [
+                r for r in doc24["results"]
+                if r["arm"] == arm and r["kill_rate"] > 0
+            ][-1]
+            assert chaotic["kills"] > 0 and chaotic["restarts_total"] > 0
+            assert (
+                chaotic["phase_seconds"][goodput.PHASE_RESTART_DOWNTIME] > 0
+            )
+            assert chaotic["loss_attribution_vs_baseline"][
+                goodput.PHASE_RESTART_DOWNTIME
+            ] > 0
+
+    def test_resilient_arm_promotes_spares(self, doc24):
+        by_arm = {
+            (r["arm"], r["kill_rate"]): r for r in doc24["results"]
+        }
+        # Hot spares exist (and get promoted) only on the resilient arm.
+        assert by_arm[("resilient", 0.3)]["spare_promotions"] > 0
+        assert by_arm[("resilient", 0.3)]["hot_spares"] == bench.HOT_SPARES
+        assert by_arm[("sync", 0.3)]["spare_promotions"] == 0
+        assert by_arm[("sync", 0.3)]["hot_spares"] == 0
+        # No chaos, no promotions: the standby capacity just parks.
+        assert by_arm[("resilient", 0.0)]["spare_promotions"] == 0
+
+    def test_async_checkpoint_tax_is_off_the_step_path(self, doc24):
+        by_arm = {
+            (r["arm"], r["kill_rate"]): r for r in doc24["results"]
+        }
+        sync_tax = by_arm[("sync", 0.0)]["checkpoint_seconds_per_job"]
+        async_tax = by_arm[("resilient", 0.0)]["checkpoint_seconds_per_job"]
+        # The async step path pays snapshots, not writes — even saving
+        # every step it costs a small fraction of the sync arm's tax.
+        assert async_tax < 0.2 * sync_tax
+
+    def test_checkpoint_scaling_sync_scales_async_does_not(self, doc24):
+        scaling = doc24["checkpoint_scaling"]
+        # Halving the save cadence halves sync checkpoint seconds...
+        assert scaling["sync"]["scaling_ratio"] == pytest.approx(2.0, rel=0.1)
+        # ...but async seconds are bounded by the write pipeline, not
+        # the cadence: saving twice as often costs (nearly) nothing.
+        assert scaling["async"]["scaling_ratio"] == pytest.approx(
+            1.0, rel=0.2
+        )
 
     def test_same_seed_bit_identical_document(self):
-        a = bench.build_doc([0.0, 0.2], jobs=30, seed=11)
-        b = bench.build_doc([0.0, 0.2], jobs=30, seed=11)
-        assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+        a = bench.build_doc([0.0, 0.2], jobs=16, seed=11)
+        b = bench.build_doc([0.0, 0.2], jobs=16, seed=11)
+        assert bench.canonical_bytes(a) == bench.canonical_bytes(b)
 
     def test_baseline_has_no_kills_or_downtime(self):
-        result = bench.run_rate(0.0, jobs=24, seed=3)
+        result = bench.run_rate(0.0, jobs=24, seed=3, arm="resilient")
         assert result["converged"] and result["kills"] == 0
         assert result["restarts_total"] == 0
+        assert result["spare_promotions"] == 0
         assert result["phase_seconds"][goodput.PHASE_RESTART_DOWNTIME] == 0.0
 
-    def test_schema_check_rejects_missing_keys(self):
-        doc = bench.build_doc([0.0], jobs=24, seed=3)
+    def test_schema_check_rejects_missing_keys(self, doc24):
+        doc = copy.deepcopy(doc24)
         del doc["results"][0]["phase_shares"]
         with pytest.raises(ValueError, match="phase_shares"):
             bench.check_schema(doc)
 
-    def test_schema_check_rejects_open_phase_vocabulary(self):
-        doc = bench.build_doc([0.0], jobs=24, seed=3)
+    def test_schema_check_rejects_open_phase_vocabulary(self, doc24):
+        doc = copy.deepcopy(doc24)
         doc["results"][0]["phase_seconds"]["coffee_break"] = 1.0
         with pytest.raises(ValueError, match="vocabulary"):
             bench.check_schema(doc)
 
-    def test_schema_check_rejects_attribution_gap(self):
-        doc = bench.build_doc([0.0], jobs=24, seed=3)
+    def test_schema_check_rejects_attribution_gap(self, doc24):
+        doc = copy.deepcopy(doc24)
         res = doc["results"][0]
         res["phase_seconds"][goodput.PHASE_QUEUE_WAIT] += (
             0.5 * res["wall_seconds_total"]
         )
         with pytest.raises(ValueError, match="deviates"):
             bench.check_schema(doc)
+
+    def test_schema_check_rejects_unknown_arm(self, doc24):
+        doc = copy.deepcopy(doc24)
+        doc["results"][0]["arm"] = "yolo"
+        with pytest.raises(ValueError, match="arm"):
+            bench.check_schema(doc)
+
+    def test_schema_check_rejects_missing_scaling_block(self, doc24):
+        doc = copy.deepcopy(doc24)
+        del doc["checkpoint_scaling"]["async"]
+        with pytest.raises(ValueError, match="checkpoint_scaling.async"):
+            bench.check_schema(doc)
+
+
+class TestBaselineGate:
+    """--baseline turns determinism into a CI regression gate: the fresh
+    artifact must match the committed one byte-for-byte."""
+
+    def test_mismatched_baseline_fails_without_clobbering(self, tmp_path):
+        out = tmp_path / "fresh.json"
+        stale = tmp_path / "stale.json"
+        stale.write_bytes(b'{"benchmark": "goodput", "stale": true}\n')
+        rc = bench.main([
+            "--jobs", "8", "--seed", "3", "--rates", "0",
+            "--out", str(out), "--baseline", str(stale),
+        ])
+        assert rc == 1
+        # The gate must not self-heal: a mismatch leaves both files as
+        # they were, so the diff stays visible.
+        assert not out.exists()
+        assert stale.read_bytes().endswith(b'"stale": true}\n')
+
+    def test_matching_baseline_passes(self, tmp_path):
+        first = tmp_path / "artifact.json"
+        rc = bench.main([
+            "--jobs", "8", "--seed", "3", "--rates", "0",
+            "--out", str(first), "--baseline", str(first),
+        ])
+        assert rc == 0 and first.exists()  # absent baseline: just write
+        rc = bench.main([
+            "--jobs", "8", "--seed", "3", "--rates", "0",
+            "--out", str(first), "--baseline", str(first),
+        ])
+        assert rc == 0  # same seed reproduces the committed bytes
+
+
+class TestCommittedArtifact:
+    """The PR-20 acceptance bars, checked against the committed
+    BENCH_GOODPUT.json (regenerated by `make bench-goodput`)."""
+
+    @pytest.fixture()
+    def committed(self):
+        path = os.path.join(_REPO_ROOT, "BENCH_GOODPUT.json")
+        with open(path) as f:
+            return json.load(f)
+
+    def test_schema_and_convergence(self, committed):
+        bench.check_schema(committed)
+        assert all(r["converged"] for r in committed["results"])
+
+    def test_resilient_arm_single_digit_goodput_loss(self, committed):
+        points = [
+            p for p in committed["curve"] if p["arm"] == "resilient"
+        ]
+        g0, g_max = points[0]["goodput_ratio"], points[-1]["goodput_ratio"]
+        loss_pct = 100.0 * (g0 - g_max) / g0
+        assert 0.0 <= loss_pct < 10.0, (
+            f"resilient arm loses {loss_pct:.1f}% goodput at max kill rate"
+        )
+        # ...and the spares did the work: promotions landed under chaos.
+        chaotic = [
+            r for r in committed["results"]
+            if r["arm"] == "resilient" and r["kill_rate"] > 0
+        ]
+        assert all(r["spare_promotions"] > 0 for r in chaotic)
+
+    def test_checkpoint_seconds_do_not_scale_with_save_frequency(
+        self, committed
+    ):
+        scaling = committed["checkpoint_scaling"]
+        assert scaling["sync"]["scaling_ratio"] >= 1.8
+        assert scaling["async"]["scaling_ratio"] <= 1.2
 
 
 @pytest.mark.slow
@@ -83,5 +227,8 @@ class TestBenchGoodputAcceptanceScale:
         doc = bench.build_doc(list(bench.KILL_RATES), jobs=100, seed=42)
         bench.check_schema(doc)
         assert all(r["converged"] for r in doc["results"])
-        ratios = [p["goodput_ratio"] for p in doc["curve"]]
-        assert ratios[0] >= ratios[-1]
+        for arm in doc["arms"]:
+            ratios = [
+                p["goodput_ratio"] for p in doc["curve"] if p["arm"] == arm
+            ]
+            assert ratios[0] >= ratios[-1]

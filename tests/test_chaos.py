@@ -750,3 +750,123 @@ class TestCheckpointTornWrite:
         step, state = self._manager(tmp_path).restore_latest(like)
         assert step is None
         assert state is like  # untouched template: cold start
+
+
+# ----------------------------------------------------------------------
+# Torn-write chaos (chaos/policy.py + engine + injector)
+# ----------------------------------------------------------------------
+
+
+class TornRunner(FakeRunner):
+    """FakeRunner plus the LocalPodRunner torn-write hook: arming a tear
+    is recorded (it would set ENV_TORN_WRITE for the replacement's
+    checkpoint manager) and reported armed exactly like the real thing."""
+
+    def __init__(self, api):
+        super().__init__(api)
+        self.armed: list[tuple[str, str]] = []
+
+    def tear_write(self, namespace: str, name: str) -> bool:
+        try:
+            pod = self.api.get("pods", namespace, name)
+        except NotFoundError:
+            return False
+        if (pod.get("status") or {}).get("phase") != "Running":
+            return False
+        self.armed.append((namespace, name))
+        return True
+
+
+def _running_pod(api, name, *, job="j1", role="worker", phase="Running"):
+    from mpi_operator_tpu.api.v2beta1.constants import JOB_ROLE_LABEL
+
+    api.create("pods", {
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "labels": {JOB_NAME_LABEL: job, JOB_ROLE_LABEL: role},
+        },
+        "spec": {"nodeName": "n0"},
+        "status": {"phase": phase},
+    })
+
+
+class TestTornWriteChaos:
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            chaos.TornWriteChaos(torn_rate=1.5)
+        with pytest.raises(ValueError):
+            chaos.TornWriteChaos(torn_rate=0.5, max_torn=-1)
+
+    def test_engine_budget_counts_confirmed_tears_only(self):
+        policy = chaos.TornWriteChaos(torn_rate=1.0, max_torn=2)
+        engine = chaos.ChaosEngine(chaos.ChaosPolicy(seed=0, torn=(policy,)))
+        assert engine.torn_fault(0, policy) is True
+        # Un-confirmed draws never eat the budget (a pod the runner
+        # could not arm does not count as a landed tear).
+        assert engine.torn_fault(0, policy) is True
+        engine.confirm_torn(0, "default/a")
+        engine.confirm_torn(0, "default/b")
+        assert engine.torn_fault(0, policy) is False  # budget exhausted
+        assert [e.kind for e in engine.events()] == [
+            chaos.TORN_WRITE, chaos.TORN_WRITE,
+        ]
+        assert engine.pod_torn_writes_total.value() == 2.0
+
+    def test_injector_arms_then_kills_and_records(self):
+        from mpi_operator_tpu.utils import flightrecorder
+
+        api = InMemoryAPIServer()
+        _running_pod(api, "j1-worker-0")
+        _running_pod(api, "j1-launcher-0", role="launcher")  # role-filtered
+        _running_pod(api, "j1-worker-1", phase="Pending")  # not Running
+        engine = chaos.ChaosEngine(chaos.ChaosPolicy(
+            seed=0, torn=(chaos.TornWriteChaos(torn_rate=1.0, max_torn=1),)
+        ))
+        runner = TornRunner(api)
+        fr = flightrecorder.FlightRecorder(clock=lambda: 5.0)
+        injector = chaos.TornWriteInjector(
+            engine, api, runner, flight_recorder=fr
+        )
+        assert injector.tick() == 1
+        # The tear was armed on the victim, then the victim was killed
+        # with the preemption signature (the death IS the fault).
+        assert runner.armed == [("default", "j1-worker-0")]
+        status = api.get("pods", "default", "j1-worker-0")["status"]
+        assert status["phase"] == "Failed"
+        assert (
+            status["containerStatuses"][0]["state"]["terminated"]["exitCode"]
+            == 137
+        )
+        assert engine.pod_torn_writes_total.value() == 1.0
+        assert injector.tick() == 0  # max_torn budget spent
+
+        # The injection is a first-class timeline entry: it survives the
+        # JSON dump and the ?kind= filter vocabulary used by the
+        # timeline endpoint.
+        import json as _json
+
+        (entry,) = fr.timeline("default", "j1", kind=flightrecorder.TORN_WRITE)
+        assert entry["reason"] == "ChaosInjected"
+        assert "killed mid-commit (marker withheld)" in entry["message"]
+        assert entry["pod"] == "j1-worker-0"
+        obj = _json.loads(fr.to_json("default", "j1"))
+        assert [e["kind"] for e in obj["entries"]] == [
+            flightrecorder.TORN_WRITE
+        ]
+        assert flightrecorder.TORN_WRITE in flightrecorder.KINDS
+
+    def test_same_seed_same_tear_timeline(self):
+        def drive(seed):
+            api = InMemoryAPIServer()
+            for i in range(4):
+                _running_pod(api, f"j1-worker-{i}")
+            engine = chaos.ChaosEngine(chaos.ChaosPolicy(
+                seed=seed, torn=(chaos.TornWriteChaos(torn_rate=0.5),)
+            ))
+            injector = chaos.TornWriteInjector(engine, api, TornRunner(api))
+            for _ in range(3):
+                injector.tick()
+            return engine.timeline()
+
+        assert drive(7) == drive(7)

@@ -1,0 +1,269 @@
+"""The durable-commit contract of utils/checkpoint.py (PR 20).
+
+Three layers under test, bottom-up: the torn-write-safe commit marker
+(temp -> fsync -> atomic rename, one marker per durable step), the
+``AsyncCheckpointManager`` that moves the orbax write off the step path
+while keeping that contract, and ``drain_final_save`` — the
+SIGTERM-path drain that lands the last checkpoint inside the
+termination grace budget exactly once (``FinalOnce``).
+
+Manager tests use real orbax on tiny numpy states; the grace-budget
+tests drive a stub manager on a fake clock so the timing assertions are
+exact and instant.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from mpi_operator_tpu.api.v2beta1 import constants
+from mpi_operator_tpu.utils import checkpoint as ckptlib
+from mpi_operator_tpu.utils.checkpoint import (
+    COMMITS_DIRNAME,
+    AsyncCheckpointManager,
+    CheckpointManager,
+    committed_steps,
+    drain_final_save,
+)
+from mpi_operator_tpu.utils.telemetry import FinalOnce, TrainingTelemetry
+
+
+def tiny_state(seed: int = 0) -> dict:
+    rng = np.random.RandomState(seed)
+    return {
+        "params": {"w": rng.randn(4, 2).astype(np.float32)},
+        "step": np.asarray(seed, np.int32),
+    }
+
+
+def like_state() -> dict:
+    return {
+        "params": {"w": np.zeros((4, 2), np.float32)},
+        "step": np.zeros((), np.int32),
+    }
+
+
+def marker_path(directory: str, step: int) -> str:
+    return os.path.join(directory, COMMITS_DIRNAME, str(step))
+
+
+class TestCommitMarkers:
+    def test_sync_save_publishes_marker(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), save_interval_steps=1)
+        assert mgr.save(1, tiny_state(1), force=True)
+        mgr.close()
+        assert committed_steps(str(tmp_path)) == {1}
+        with open(marker_path(str(tmp_path), 1)) as f:
+            assert f.read() == "1"
+
+    def test_committed_steps_none_for_legacy_layout(self, tmp_path):
+        # No .commits directory at all: the layout predates markers and
+        # must stay restorable, signalled by None (not the empty set).
+        assert committed_steps(str(tmp_path)) is None
+
+    def test_committed_steps_ignores_inflight_temp_files(self, tmp_path):
+        commits = tmp_path / COMMITS_DIRNAME
+        commits.mkdir()
+        (commits / "3").write_text("3")
+        (commits / ".7.tmp").write_text("7")  # writer died pre-rename
+        assert committed_steps(str(tmp_path)) == {3}
+
+    def test_restore_skips_step_without_marker(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), save_interval_steps=1)
+        mgr.save(1, tiny_state(1), force=True)
+        mgr.save(2, tiny_state(2), force=True)
+        mgr.close()
+        # Tear step 2's commit after the fact: data on disk, no marker —
+        # the on-disk state a writer killed mid-commit leaves behind.
+        os.unlink(marker_path(str(tmp_path), 2))
+
+        fresh = CheckpointManager(str(tmp_path))
+        step, state = fresh.restore_latest(like_state())
+        fresh.close()
+        assert step == 1
+        np.testing.assert_array_equal(
+            np.asarray(state["params"]["w"]), tiny_state(1)["params"]["w"]
+        )
+
+    def test_restore_trusts_legacy_checkpoints_without_markers(
+        self, tmp_path
+    ):
+        import shutil
+
+        mgr = CheckpointManager(str(tmp_path), save_interval_steps=1)
+        mgr.save(5, tiny_state(5), force=True)
+        mgr.close()
+        shutil.rmtree(tmp_path / COMMITS_DIRNAME)
+
+        fresh = CheckpointManager(str(tmp_path))
+        step, _ = fresh.restore_latest(like_state())
+        fresh.close()
+        assert step == 5
+
+
+class TestAsyncCheckpointManager:
+    def test_save_commits_in_background(self, tmp_path):
+        mgr = AsyncCheckpointManager(str(tmp_path), save_interval_steps=1)
+        assert mgr.save(1, tiny_state(1)) is True
+        assert mgr.drain(10.0) is True
+        mgr.close()
+        assert committed_steps(str(tmp_path)) == {1}
+
+        fresh = CheckpointManager(str(tmp_path))
+        step, state = fresh.restore_latest(like_state())
+        fresh.close()
+        assert step == 1
+        np.testing.assert_array_equal(
+            np.asarray(state["params"]["w"]), tiny_state(1)["params"]["w"]
+        )
+
+    def test_save_interval_policy(self, tmp_path):
+        mgr = AsyncCheckpointManager(str(tmp_path), save_interval_steps=2)
+        assert mgr.save(1, tiny_state(1)) is False  # off-interval
+        assert mgr.save(2, tiny_state(2)) is True
+        assert mgr.drain(10.0)
+        assert mgr.save(2, tiny_state(2)) is False  # already saved
+        mgr.close()
+
+    def test_write_in_flight_skips_save(self, tmp_path):
+        """One write in flight at a time: a save arriving while the
+        writer is busy is skipped — the property that keeps the step-path
+        checkpoint cost flat regardless of save frequency."""
+        mgr = AsyncCheckpointManager(str(tmp_path), save_interval_steps=1)
+        gate = threading.Event()
+        busy = threading.Thread(target=gate.wait, name="fake-writer")
+        busy.start()
+        mgr._writer = busy
+        try:
+            assert mgr.save(3, tiny_state(3)) is False
+        finally:
+            gate.set()
+            busy.join()
+        mgr.close()
+        assert committed_steps(str(tmp_path)) in (None, set())
+
+    def test_env_torn_write_tears_exactly_one_commit(
+        self, tmp_path, monkeypatch
+    ):
+        mgr = CheckpointManager(str(tmp_path), save_interval_steps=1)
+        mgr.save(1, tiny_state(1), force=True)
+        mgr.close()
+
+        # The chaos hook (chaos/podchaos.TornWriteInjector arms it via
+        # LocalPodRunner.tear_write) tears the NEXT commit only.
+        monkeypatch.setenv(constants.ENV_TORN_WRITE, "1")
+        torn = AsyncCheckpointManager(str(tmp_path), save_interval_steps=1)
+        assert torn.save(2, tiny_state(2)) is True
+        assert torn.drain(10.0)
+        assert torn.torn_writes == 1
+        # Step 2's data is on disk, but it was never committed...
+        assert committed_steps(str(tmp_path)) == {1}
+        assert 2 in (torn._mgr.all_steps() or ())
+        # ...and the tear is one-shot: the next commit lands normally.
+        assert torn.save(3, tiny_state(3)) is True
+        assert torn.drain(10.0)
+        assert torn.torn_writes == 1
+        torn.close()
+        assert committed_steps(str(tmp_path)) == {1, 3}
+
+        # End to end: restore falls back around the torn step.
+        fresh = CheckpointManager(str(tmp_path))
+        step, _ = fresh.restore_latest(like_state())
+        fresh.close()
+        assert step == 3
+
+
+class FakeClock:
+    def __init__(self, now: float = 100.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+
+class StubManager:
+    """drain_final_save's contract surface, with scripted timing."""
+
+    def __init__(self, clock: FakeClock, *, save_cost_s: float = 0.0,
+                 drain_cost_s: float = 0.0, fail_save: bool = False):
+        self.final_latch = FinalOnce()
+        self._clock = clock
+        self._save_cost = save_cost_s
+        self._drain_cost = drain_cost_s
+        self._fail_save = fail_save
+        self.saves: list[int] = []
+        self.drain_budgets: list[float] = []
+
+    def save(self, step, state, *, force=False):
+        if self._fail_save:
+            raise RuntimeError("disk gone")
+        self.saves.append(step)
+        self._clock.now += self._save_cost
+        return True
+
+    def drain(self, timeout_s=None):
+        self.drain_budgets.append(timeout_s)
+        spent = self._drain_cost
+        if timeout_s is not None and spent > timeout_s:
+            self._clock.now += timeout_s
+            return False  # still in flight when the budget ran out
+        self._clock.now += spent
+        return True
+
+
+class TestDrainFinalSave:
+    def test_drains_within_grace_and_records_telemetry(self):
+        clock = FakeClock()
+        mgr = StubManager(clock, save_cost_s=3.0, drain_cost_s=4.0)
+        telem = TrainingTelemetry(clock=clock)
+        assert drain_final_save(
+            mgr, 7, {"x": 1}, telem, grace_s=10.0, clock=clock
+        ) is True
+        assert mgr.saves == [7]
+        # The drain budget is the grace minus what the save spent.
+        assert mgr.drain_budgets == [pytest.approx(7.0)]
+        # SIGTERM-path checkpoint seconds land in telemetry (the ledger
+        # carves them out of the job's productive phase downstream).
+        assert telem._checkpoint_s == pytest.approx(7.0)
+
+    def test_grace_budget_exhausted_returns_false(self):
+        clock = FakeClock()
+        mgr = StubManager(clock, save_cost_s=2.0, drain_cost_s=60.0)
+        telem = TrainingTelemetry(clock=clock)
+        assert drain_final_save(
+            mgr, 7, {"x": 1}, telem, grace_s=5.0, clock=clock
+        ) is False
+        # Wall time spent is still charged, capped by the grace budget.
+        assert telem._checkpoint_s == pytest.approx(5.0)
+
+    def test_final_latch_claims_exactly_once(self):
+        clock = FakeClock()
+        mgr = StubManager(clock, save_cost_s=1.0)
+        telem = TrainingTelemetry(clock=clock)
+        assert drain_final_save(
+            mgr, 7, {"x": 1}, telem, grace_s=10.0, clock=clock
+        ) is True
+        # Every later path (signal handler vs loop epilogue racing on
+        # SIGTERM) is a no-op: one save, one telemetry charge — the
+        # "never double-emit the final record" contract.
+        assert drain_final_save(
+            mgr, 8, {"x": 1}, telem, grace_s=10.0, clock=clock
+        ) is False
+        assert mgr.saves == [7]
+        assert telem._checkpoint_s == pytest.approx(1.0)
+
+    def test_save_failure_still_records_and_releases(self):
+        clock = FakeClock()
+        mgr = StubManager(clock, fail_save=True)
+        telem = TrainingTelemetry(clock=clock)
+        assert drain_final_save(
+            mgr, 7, {"x": 1}, telem, grace_s=10.0, clock=clock
+        ) is False
+        assert telem._checkpoint_s == pytest.approx(0.0)
+
+    def test_grace_default_matches_kube_termination_window(self):
+        # Documented contract: headroom under the 30s kube default
+        # terminationGracePeriodSeconds.
+        assert ckptlib.DEFAULT_FINAL_GRACE_S < 30.0

@@ -880,3 +880,178 @@ class TestStaleCacheCreateRace:
                for e in pod["spec"]["containers"][0]["env"]}
         assert env["TPUJOB_NUM_PROCESSES"] == "8"
         assert st.has_condition(f.get_job().status, "Restarting")
+
+
+class TestHotSpares:
+    """spec.tpu.hotSpares: parked standby workers + promotion on a
+    restart-eligible worker death (PR 20 tentpole, controller side)."""
+
+    def _spare_job(self, f: Fixture, spares=1, workers=4):
+        job = f.new_job(workers=workers, backoff_limit=2)
+        job.spec.tpu.hot_spares = spares
+        job.spec.replica_specs[REPLICA_TYPE_WORKER].restart_policy = (
+            "OnFailure"
+        )
+        f.start()
+        created = f.create_job(job)
+        f.sync(created)
+        return f.get_job()
+
+    def _park_spare(self, f: Fixture, name: str, node: str):
+        """What the kubelet sim does to a scheduled spare: bind + Run."""
+        pod = f.api.get("pods", "default", name)
+        pod["spec"]["nodeName"] = node
+        f.api.update("pods", pod)
+        f.set_pod_phase(name, "Running")
+        f.controller.factory.pump_until_quiet()
+
+    def test_spares_created_parked_not_training(self):
+        from mpi_operator_tpu.api.v2beta1 import constants
+
+        f = Fixture()
+        self._spare_job(f, spares=2)
+        assert len(f.api.list("pods")) == 6  # 4 workers + 2 spares
+        for k in range(2):
+            pod = f.api.get("pods", "default", f"test-job-spare-{k}")
+            meta = pod["metadata"]
+            assert meta["annotations"][constants.STANDBY_ANNOTATION] == "true"
+            assert (
+                meta["labels"][constants.JOB_ROLE_LABEL]
+                == constants.ROLE_SPARE
+            )
+            container = pod["spec"]["containers"][0]
+            # Parked, never training: the user command is replaced with
+            # the park loop, but the chip footprint is worker-shaped so
+            # the held node can take a promoted worker without a
+            # scheduling pass.
+            assert container["command"] == [
+                "python", "-m", "mpi_operator_tpu.launcher.park",
+            ]
+            assert (
+                container["resources"]["limits"][constants.TPU_RESOURCE_NAME]
+                == 4
+            )
+
+    def test_spare_gang_is_separate_podgroup(self):
+        f = Fixture(gang="volcano")
+        job = f.new_job(backoff_limit=2)
+        job.spec.tpu.hot_spares = 2
+        f.start()
+        f.sync(f.create_job(job))
+        # The worker gang never waits on standby capacity: spares form
+        # their own PodGroup and the worker minMember excludes them.
+        assert f.api.get(
+            "podgroups", "default", "test-job"
+        )["spec"]["minMember"] == 4
+        assert f.api.get(
+            "podgroups", "default", "test-job-spare"
+        )["spec"]["minMember"] == 2
+        spare = f.api.get("pods", "default", "test-job-spare-0")
+        assert (
+            spare["metadata"]["annotations"]["scheduling.k8s.io/group-name"]
+            == "test-job-spare"
+        )
+
+    def test_promotion_prebinds_replacement_and_backfills(self):
+        from mpi_operator_tpu.api.v2beta1 import constants
+        from mpi_operator_tpu.runtime.apiserver import NotFoundError
+
+        f = Fixture()
+        job = self._spare_job(f)
+        self._park_spare(f, "test-job-spare-0", "node-7")
+        before = f.controller.spare_promotions.value()
+        f.set_pod_phase(builders.worker_name(job, 0), "Failed")
+        f.sync(job)
+
+        # The replacement worker inherits the spare's warm node: it is
+        # pre-bound (the gang scheduler skips it) and stamped with the
+        # spare it consumed.
+        repl = f.api.get("pods", "default", builders.worker_name(job, 0))
+        assert repl["spec"]["nodeName"] == "node-7"
+        assert (
+            repl["metadata"]["annotations"][
+                constants.PROMOTED_FROM_ANNOTATION
+            ]
+            == "test-job-spare-0"
+        )
+        assert f.controller.spare_promotions.value() == before + 1
+        with pytest.raises(NotFoundError):
+            f.api.get("pods", "default", "test-job-spare-0")
+        # The promotion landed on the job's timeline for postmortems.
+        entries = f.controller.flight_recorder.timeline("default", "test-job")
+        (promo,) = [
+            e for e in entries
+            if e["reason"] == "SparePromoted" and e["kind"] == "pod"
+        ]
+        assert promo["spare"] == "test-job-spare-0"
+        assert promo["node"] == "node-7"
+        assert ("Normal", "SparePromoted") in f.events()
+        # The consumed standby seat is backfilled next sync, off the
+        # restart's critical path.
+        f.sync(job)
+        fresh = f.api.get("pods", "default", "test-job-spare-0")
+        assert (fresh.get("status") or {}).get("phase") is None  # cold
+
+    def test_no_ready_spare_takes_ordinary_path(self):
+        from mpi_operator_tpu.api.v2beta1 import constants
+
+        f = Fixture()
+        job = self._spare_job(f)
+        # The spare exists but is still Pending/unbound: nothing to
+        # promote, so the replacement takes schedule->pending->bootstrap.
+        before = f.controller.spare_promotions.value()
+        f.set_pod_phase(builders.worker_name(job, 1), "Failed")
+        f.sync(job)
+        repl = f.api.get("pods", "default", builders.worker_name(job, 1))
+        assert not (repl["spec"].get("nodeName"))
+        assert (
+            constants.PROMOTED_FROM_ANNOTATION
+            not in (repl["metadata"].get("annotations") or {})
+        )
+        assert f.controller.spare_promotions.value() == before
+
+    def test_failed_spare_replaced_without_charging_backoff(self):
+        f = Fixture()
+        job = self._spare_job(f)
+        f.set_pod_phase("test-job-spare-0", "Failed")
+        f.sync(job)
+        fresh = f.api.get("pods", "default", "test-job-spare-0")
+        assert (fresh.get("status") or {}).get("phase") != "Failed"
+        # A dead standby cost the job nothing: restarts budget untouched.
+        status = f.get_job().status.replica_statuses[REPLICA_TYPE_WORKER]
+        assert status.restarts == 0
+
+    def test_scale_down_deletes_excess_spares(self):
+        from mpi_operator_tpu.runtime.apiserver import NotFoundError
+
+        f = Fixture()
+        job = self._spare_job(f, spares=2)
+        jd = f.api.get("tpujobs", "default", "test-job")
+        jd["spec"]["tpu"] = {"acceleratorType": "v5e-16", "hotSpares": 1}
+        f.api.update("tpujobs", jd)
+        f.sync(job)
+        f.controller.factory.pump_until_quiet()
+        assert f.api.get("pods", "default", "test-job-spare-0")
+        with pytest.raises(NotFoundError):
+            f.api.get("pods", "default", "test-job-spare-1")
+
+    def test_terminal_job_deletes_spares_unconditionally(self):
+        f = Fixture()
+        job = self._spare_job(f)
+        # cleanPodPolicy defaults keep workers around, but a parked
+        # standby is pure held capacity: it must go on completion.
+        f.set_all_workers_phase(job, "Succeeded")
+        f.sync(job)
+        f.sync(job)  # finished + stamped -> cleanup branch
+        names = {p["metadata"]["name"] for p in f.api.list("pods")}
+        assert "test-job-spare-0" not in names
+
+    def test_suspend_deletes_spares(self):
+        f = Fixture()
+        job = self._spare_job(f)
+        jd = f.api.get("tpujobs", "default", "test-job")
+        jd["spec"]["runPolicy"] = {"suspend": True, "cleanPodPolicy": "None"}
+        f.api.update("tpujobs", jd)
+        f.sync(job)
+        f.controller.factory.pump_until_quiet()
+        assert f.api.list("pods") == []

@@ -1391,6 +1391,10 @@ class TestTimelineEndpoint:
         fr.record("default", "j1", flightrecorder.MEM_LEAK,
                   reason="ChaosInjected",
                   message="pod j1-worker-1: leaking 4096 bytes/window")
+        fr.record("default", "j1", flightrecorder.TORN_WRITE,
+                  reason="ChaosInjected",
+                  message="pod j1-worker-2: killed mid-commit "
+                          "(marker withheld)")
         server, base = _monitoring_server(flight_recorder=fr)
         try:
             def fetch(query):
@@ -1404,6 +1408,8 @@ class TestTimelineEndpoint:
             assert "factor=2.0" in slow["message"]
             (leak,) = fetch("?kind=mem_leak")
             assert "4096 bytes/window" in leak["message"]
+            (torn,) = fetch("?kind=torn_write")
+            assert "marker withheld" in torn["message"]
             assert fetch("?kind=memory") == []  # valid kind, no entries
         finally:
             server.shutdown()

@@ -521,3 +521,103 @@ class TestPluginInterface:
         in_slice = NodeInfo(name="a", capacity=4, slice_name="s0")
         other = NodeInfo(name="b", capacity=4, slice_name="s1")
         assert plugin.score(ctx, pod, in_slice) > plugin.score(ctx, pod, other)
+
+
+class TestStandbyCapacity:
+    """Hot-spare standby gangs (spec.tpu.hotSpares) in the scheduler:
+    tallied as reclaimable capacity, sorted behind live work, and the
+    first preemption victims in their priority band."""
+
+    @staticmethod
+    def _standby_pod(name, gang, **kw):
+        from mpi_operator_tpu.api.v2beta1.constants import STANDBY_ANNOTATION
+
+        pod = make_pod(name, gang, **kw)
+        pod["metadata"]["annotations"][STANDBY_ANNOTATION] = "true"
+        return pod
+
+    def test_reconcile_tallies_standby_chips(self):
+        from mpi_operator_tpu.scheduler.cache import is_standby_pod
+
+        cache = SchedulerCache()
+        for node in build_nodes("v5e-16:1"):
+            cache.add_node(NodeInfo.from_node_object(node))
+        live = make_pod("w0", "g")
+        live["spec"]["nodeName"] = "tpu-v5e-16-s0-h0"
+        spare = self._standby_pod("sp0", "g-spare")
+        spare["spec"]["nodeName"] = "tpu-v5e-16-s0-h1"
+        assert not is_standby_pod(live) and is_standby_pod(spare)
+        cache.reconcile([live, spare])
+        # Standby is a *subset* of allocated, never extra capacity.
+        assert cache.total_allocated() == 8
+        assert cache.total_standby() == 4
+        assert cache.nodes["tpu-v5e-16-s0-h1"].standby == 4
+        assert cache.nodes["tpu-v5e-16-s0-h0"].standby == 0
+
+    def test_chips_gauge_exposes_standby_state(self):
+        api = InMemoryAPIServer()
+        register_nodes(api, "v5e-16:1")
+        s = GangScheduler(api, clock=lambda: NOW)
+        make_group(api, "sp", 4)
+        for i in range(4):
+            api.create("pods", self._standby_pod(f"sp-{i}", "sp"))
+        assert s.schedule_once()["bound"] == 4
+        # The standby tally is rebuilt from *live bound* pods at each
+        # pass's reconcile: the next pass sees the newly bound spares.
+        assert s.schedule_once()["bound"] == 0
+        text = s.registry.expose()
+        assert 'tpu_operator_scheduler_chips{state="standby"} 16' in text
+        assert 'tpu_operator_scheduler_chips{state="allocated"} 16' in text
+
+    def test_standby_gang_sorts_behind_live_gang(self):
+        api = InMemoryAPIServer()
+        register_nodes(api, "v5e-16:1")
+        s = GangScheduler(api, clock=lambda: NOW)
+        # The standby gang is created FIRST: arrival order must not let
+        # parked spares delay real work at the same priority.
+        make_group(api, "sp", 4)
+        for i in range(4):
+            api.create("pods", self._standby_pod(f"sp-{i}", "sp"))
+        make_group(api, "live", 4)
+        for i in range(4):
+            api.create("pods", make_pod(f"live-{i}", "live"))
+        out = s.schedule_once()
+        assert out == {"bound": 4, "pending_gangs": 1}
+        assert all(
+            api.get("pods", "default", f"live-{i}")["spec"].get("nodeName")
+            for i in range(4)
+        )
+        assert all(
+            not api.get("pods", "default", f"sp-{i}")["spec"].get("nodeName")
+            for i in range(4)
+        )
+
+    def test_preemption_evicts_standby_gang_before_live_gang(self):
+        from mpi_operator_tpu.runtime.apiserver import NotFoundError
+
+        api = InMemoryAPIServer()
+        register_nodes(api, "v5e-16:2")
+        s = GangScheduler(api, clock=lambda: NOW)
+        make_group(api, "low-live", 4, priority_class="low-priority")
+        for i in range(4):
+            api.create("pods", make_pod(f"low-live-{i}", "low-live"))
+        make_group(api, "low-sp", 4, priority_class="low-priority")
+        for i in range(4):
+            api.create(
+                "pods", self._standby_pod(f"low-sp-{i}", "low-sp")
+            )
+        assert s.schedule_once()["bound"] == 8  # both slices occupied
+
+        make_group(api, "high", 4, priority_class="high-priority")
+        for i in range(4):
+            api.create("pods", make_pod(f"high-{i}", "high"))
+        assert s.schedule_once()["bound"] == 4
+        # Evicting parked spares costs zero training progress: the
+        # standby gang goes, the live low-priority gang keeps running.
+        for i in range(4):
+            with pytest.raises(NotFoundError):
+                api.get("pods", "default", f"low-sp-{i}")
+            assert api.get(
+                "pods", "default", f"low-live-{i}"
+            )["spec"].get("nodeName")
+        assert s.preemptions_total.value() == 1
